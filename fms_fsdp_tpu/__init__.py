@@ -1,0 +1,24 @@
+"""fms_fsdp_tpu — a TPU-native (JAX/XLA/Pallas) pretraining framework.
+
+A from-scratch rebuild of the capability surface of fms-fsdp (IBM's
+Llama/Mamba pretraining harness on PyTorch FSDP) designed TPU-first:
+
+- sharding via ``jax.sharding`` NamedSharding over a device ``Mesh``
+  (GSPMD-inserted all-gather / reduce-scatter over ICI) instead of the
+  FSDP FlatParameter runtime,
+- one jitted train step (fwd / loss / bwd / clip / update) instead of
+  ``torch.compile`` + eager glue,
+- Pallas kernels for flash attention and the Mamba selective scan
+  instead of CUDA/Triton,
+- a stateful, rescalable streaming dataloader (host-side, numpy)
+  matching the reference's checkpoint/resume/rescale semantics.
+
+Reference behavior studied from /root/reference (fms-fsdp); citations in
+docstrings use the form ``ref:<path>:<lines>``.
+"""
+
+from fms_fsdp_tpu.config import TrainConfig, train_config
+
+__version__ = "0.1.0"
+
+__all__ = ["TrainConfig", "train_config", "__version__"]

@@ -1,0 +1,7 @@
+from fms_fsdp_tpu.config.training import TrainConfig
+
+# Alias matching the reference's lowercase dataclass name
+# (ref:fms_fsdp/config/training.py:6).
+train_config = TrainConfig
+
+__all__ = ["TrainConfig", "train_config"]

@@ -1,0 +1,3 @@
+from fms_fsdp_tpu.models.configs import LlamaConfig, MambaConfig
+
+__all__ = ["LlamaConfig", "MambaConfig"]

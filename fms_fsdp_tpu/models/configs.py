@@ -1,0 +1,116 @@
+"""Model architecture configs.
+
+``LlamaConfig`` carries the same architectural degrees of freedom the
+reference exercises through fms's ``LLaMAConfig`` (variant table at
+ref:fms_fsdp/utils/config_utils.py:25-161): emb_dim, nheads, kvheads (GQA),
+nlayers, hidden_grow_factor + multiple_of (SwiGLU width rounding),
+max_expected_seq_len, rope_theta, vocab size.
+
+``MambaConfig`` mirrors the mamba_9.8b dict config
+(ref:fms_fsdp/utils/config_utils.py:162-185): Mamba2 layers with a few
+interleaved attention layers, RMSNorm, residual in fp32.
+"""
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    src_vocab_size: int = 32000
+    emb_dim: int = 4096
+    norm_eps: float = 1e-5
+    nheads: int = 32
+    kvheads: int = 0  # 0 -> MHA (kvheads = nheads), else GQA group count
+    nlayers: int = 32
+    hidden_grow_factor: float = 8 / 3
+    multiple_of: int = 256
+    max_expected_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    p_dropout: float = 0.0
+
+    @property
+    def head_dim(self) -> int:
+        return self.emb_dim // self.nheads
+
+    @property
+    def n_kv_heads(self) -> int:
+        return self.kvheads if self.kvheads else self.nheads
+
+    @property
+    def hidden_dim(self) -> int:
+        """SwiGLU inner width with multiple_of rounding (fms GatedLinearUnit)."""
+        hidden = int(self.emb_dim * self.hidden_grow_factor)
+        if self.multiple_of:
+            hidden = self.multiple_of * (
+                (hidden + self.multiple_of - 1) // self.multiple_of
+            )
+        return hidden
+
+    def n_params(self, include_embeddings: bool = True) -> int:
+        """Exact parameter count (untied input/output embeddings)."""
+        d, h = self.emb_dim, self.hidden_dim
+        kv_dim = self.n_kv_heads * self.head_dim
+        per_layer = (
+            d * d  # wq
+            + 2 * d * kv_dim  # wk, wv
+            + d * d  # wo
+            + 3 * d * h  # w1 and w3 (d->h each), w2 (h->d)
+            + 2 * d  # attn norm + ffn norm
+        )
+        total = self.nlayers * per_layer + d  # final norm
+        if include_embeddings:
+            total += 2 * self.src_vocab_size * d  # embed + lm head
+        return int(total)
+
+
+@dataclass(frozen=True)
+class MambaAttnConfig:
+    """Attention sub-config for hybrid Mamba (ref:config_utils.py:170-179)."""
+
+    causal: bool = True
+    d_conv: int = 0
+    head_dim: int = 128
+    num_heads: int = 32
+    num_heads_kv: int = 8
+    out_proj_bias: bool = False
+    qkv_proj_bias: bool = False
+    rotary_emb_dim: int = 64
+
+
+@dataclass(frozen=True)
+class MambaConfig:
+    d_model: int = 4096
+    d_intermediate: int = 14336  # MLP width; 0 -> no MLP block
+    n_layer: int = 32
+    vocab_size: int = 128256
+    ssm_layer: str = "Mamba2"
+    attn_layer_idx: Tuple[int, ...] = ()
+    attn_cfg: MambaAttnConfig = field(default_factory=MambaAttnConfig)
+    rms_norm: bool = True
+    residual_in_fp32: bool = True
+    fused_add_norm: bool = True
+    pad_vocab_size_multiple: int = 16
+    tie_embeddings: bool = False
+    norm_eps: float = 1e-5
+    # Mamba2 layer hyperparameters (mamba_ssm defaults)
+    d_state: int = 128
+    d_conv: int = 4
+    expand: int = 2
+    headdim: int = 64
+    ngroups: int = 1
+    chunk_size: int = 256
+
+    @property
+    def padded_vocab_size(self) -> int:
+        m = self.pad_vocab_size_multiple
+        return m * ((self.vocab_size + m - 1) // m)
+
+    @property
+    def d_inner(self) -> int:
+        return self.expand * self.d_model
+
+    @property
+    def nheads(self) -> int:
+        return self.d_inner // self.headdim

@@ -1,0 +1,191 @@
+"""Llama-family decoder, TPU-native.
+
+Replaces the reference's external model dependency (fms ``LLaMA`` /
+``LLaMABlock``, imported at ref:main_training_llama.py:7) with a functional
+JAX implementation:
+
+- params are a plain pytree with all layers *stacked on a leading L axis*,
+  so the layer stack runs as one ``lax.scan`` (one compiled block body —
+  the XLA analog of wrapping every block as an identical FSDP unit);
+- mixed precision is a cast at function entry (policies/mixed_precision);
+- selective activation checkpointing is ``jax.checkpoint`` applied to the
+  scan body (uniform masks) or to individual unrolled layers (fractional
+  masks), selected by the reference-exact mask (parallel/ac.py);
+- sharding is expressed only through constraints; GSPMD inserts the
+  all-gathers/reduce-scatters the FSDP runtime does by hand.
+
+Architecture degrees of freedom match the reference variant table
+(ref:fms_fsdp/utils/config_utils.py:25-161): RMSNorm, RoPE with variant
+theta, GQA, SwiGLU with multiple_of rounding, untied embeddings.
+"""
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fms_fsdp_tpu.models.configs import LlamaConfig
+from fms_fsdp_tpu.ops.attention import attention
+from fms_fsdp_tpu.ops.norms import rms_norm
+from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
+from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_TENSOR, DATA_AXES
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_llama_params(
+    key, cfg: LlamaConfig, dtype=jnp.float32, nlayers: Optional[int] = None
+) -> Params:
+    """Initialize the full param tree.
+
+    Truncated-normal std 0.02 everywhere, with the residual-output
+    projections (wo, w2) scaled by 1/sqrt(2*nlayers) (GPT-2-style depth
+    scaling) so the residual stream variance is depth-independent.
+    """
+    nlayers = nlayers if nlayers is not None else cfg.nlayers
+    d = cfg.emb_dim
+    h = cfg.hidden_dim
+    hd = cfg.head_dim
+    nq, nkv = cfg.nheads, cfg.n_kv_heads
+    v = cfg.src_vocab_size
+    std = 0.02
+    out_std = std / (2 * nlayers) ** 0.5
+
+    keys = jax.random.split(key, 8)
+
+    def tn(k, shape, s):
+        return (jax.random.truncated_normal(k, -3, 3, shape, jnp.float32) * s).astype(
+            dtype
+        )
+
+    L = nlayers
+    layers = {
+        "attn_norm": jnp.ones((L, d), dtype),
+        "wq": tn(keys[0], (L, d, nq * hd), std),
+        "wk": tn(keys[1], (L, d, nkv * hd), std),
+        "wv": tn(keys[2], (L, d, nkv * hd), std),
+        "wo": tn(keys[3], (L, nq * hd, d), out_std),
+        "ffn_norm": jnp.ones((L, d), dtype),
+        "w1": tn(keys[4], (L, d, h), std),
+        "w3": tn(keys[5], (L, d, h), std),
+        "w2": tn(keys[6], (L, h, d), out_std),
+    }
+    return {
+        "embedding": tn(keys[7], (v, d), std),
+        "layers": layers,
+        "norm": jnp.ones((d,), dtype),
+        "lm_head": tn(jax.random.fold_in(keys[7], 1), (d, v), std),
+    }
+
+
+def param_count(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _constrain(x, spec: Optional[P], mesh: Optional[Mesh]):
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
+
+
+def _llama_block(
+    x,
+    layer: Params,
+    cfg: LlamaConfig,
+    cos,
+    sin,
+    *,
+    attn_impl: str,
+    mesh: Optional[Mesh],
+):
+    """One decoder block: x + Attn(RMS(x)); then x + SwiGLU(RMS(x))."""
+    b, s, d = x.shape
+    hd = cfg.head_dim
+    nq, nkv = cfg.nheads, cfg.n_kv_heads
+
+    head_spec = P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR, None)
+
+    # NOTE: params arrive pre-cast to the compute dtype (single cast site at
+    # llama_forward entry — that placement is what makes GSPMD all-gather
+    # bf16 bytes).
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"]).reshape(b, s, nq, hd)
+    k = (h @ layer["wk"]).reshape(b, s, nkv, hd)
+    v = (h @ layer["wv"]).reshape(b, s, nkv, hd)
+    q = _constrain(q, head_spec, mesh)
+    k = _constrain(k, head_spec, mesh)
+    q = apply_rotary(q, cos, sin)
+    k = apply_rotary(k, cos, sin)
+    o = attention(q, k, v, causal=True, impl=attn_impl)
+    o = o.reshape(b, s, nq * hd) @ layer["wo"]
+    x = x + _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+
+    h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer["w1"])
+    up = h @ layer["w3"]
+    ffn = _constrain(gate * up, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
+    ffn = ffn @ layer["w2"]
+    return x + _constrain(ffn, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+
+
+def llama_forward(
+    params: Params,
+    tokens,
+    cfg: LlamaConfig,
+    *,
+    compute_dtype=jnp.bfloat16,
+    attn_impl: str = "auto",
+    ac_mask: Optional[List[bool]] = None,
+    scan_layers: bool = True,
+    mesh: Optional[Mesh] = None,
+):
+    """tokens (B, S) int32 -> logits (B, S, V) float32."""
+    nlayers = params["layers"]["wq"].shape[0]
+    # Cast the whole tree to compute dtype up front: with fp32 storage this
+    # makes GSPMD's param all-gathers move bf16 bytes (the bfSixteen
+    # comm-volume behavior, ref:policies/mixed_precision.py:11-15), not fp32.
+    params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    x = params["embedding"][tokens]
+    x = _constrain(x, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+
+    seq_len = tokens.shape[1]
+    cos, sin = rope_table(seq_len, cfg.head_dim, cfg.rope_theta)
+
+    block = functools.partial(
+        _llama_block, cfg=cfg, cos=cos, sin=sin, attn_impl=attn_impl, mesh=mesh
+    )
+    ac_mask = ac_mask if ac_mask is not None else [False] * nlayers
+    uniform = all(ac_mask) or not any(ac_mask)
+
+    if scan_layers and uniform:
+        body = block
+        if all(ac_mask):
+            body = jax.checkpoint(block, prevent_cse=False)
+
+        def scan_fn(carry, layer):
+            return body(carry, layer), None
+
+        x, _ = lax.scan(scan_fn, x, params["layers"])
+    else:
+        remat_block = jax.checkpoint(block, prevent_cse=False)
+        for i in range(nlayers):
+            layer = jax.tree.map(lambda a: a[i], params["layers"])
+            x = (remat_block if ac_mask[i] else block)(x, layer)
+
+    x = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"]
+    logits = _constrain(logits, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
+    return logits.astype(jnp.float32)

@@ -1,0 +1,4 @@
+from fms_fsdp_tpu.ops.norms import rms_norm
+from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
+
+__all__ = ["rms_norm", "apply_rotary", "rope_table"]

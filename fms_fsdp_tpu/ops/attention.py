@@ -1,0 +1,78 @@
+"""Attention ops.
+
+Replaces the reference's SDPA FlashAttention-2 CUDA path (credited at
+ref:README.md:5,46; invoked inside fms LLaMA's MultiHeadAttention). Two
+implementations behind one dispatcher:
+
+- "xla":    jnp einsum attention with fp32 softmax — always correct, used
+            for CPU tests and as numerical ground truth. XLA fuses it but
+            materializes the (B, N, S, S) score matrix.
+- "pallas": blockwise MXU-tiled causal flash attention (ops/flash_attention.py)
+            — O(S) memory, GQA-aware, written blockwise so a "context" mesh
+            axis (ring attention) composes with it.
+
+All functions take q:(B, S, Nq, H), k/v:(B, S, Nkv, H) with Nq % Nkv == 0
+(GQA: 64/8 heads at 70B per ref:config_utils.py:26-34).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def repeat_kv(k, n_rep: int):
+    """(B, S, Nkv, H) -> (B, S, Nkv*n_rep, H) by repeating each kv head."""
+    if n_rep == 1:
+        return k
+    b, s, nkv, h = k.shape
+    k = jnp.broadcast_to(k[:, :, :, None, :], (b, s, nkv, n_rep, h))
+    return k.reshape(b, s, nkv * n_rep, h)
+
+
+def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None):
+    """Reference einsum attention with fp32 softmax."""
+    b, sq, nq, h = q.shape
+    nkv = k.shape[2]
+    scale = scale if scale is not None else h**-0.5
+    group = nq // nkv
+    # Grouped matmul: fold the GQA group into the query head dim so kv heads
+    # are never materialized repeated.
+    qg = q.reshape(b, sq, nkv, group, h)
+    scores = (
+        jnp.einsum(
+            "bqkgh,bskh->bkgqs", qg, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
+    if causal:
+        sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", probs, v)
+    return out.reshape(b, sq, nq, h)
+
+
+try:  # the Pallas kernel only lowers on TPU backends
+    from fms_fsdp_tpu.ops.flash_attention import flash_attention as _flash
+
+    HAS_PALLAS_FLASH = True
+except ImportError:
+    _flash = None
+    HAS_PALLAS_FLASH = False
+
+
+def attention(q, k, v, *, causal: bool = True, impl: str = "auto"):
+    """Dispatch to the Pallas flash kernel on TPU, XLA einsum elsewhere."""
+    if impl == "pallas":
+        if not HAS_PALLAS_FLASH:
+            raise NotImplementedError(
+                "attention_kernel='pallas' requested but the Pallas flash "
+                "attention kernel is unavailable in this build"
+            )
+        return _flash(q, k, v, causal=causal)
+    if impl == "auto" and HAS_PALLAS_FLASH and jax.default_backend() == "tpu":
+        return _flash(q, k, v, causal=causal)
+    return xla_attention(q, k, v, causal=causal)

@@ -1,0 +1,19 @@
+"""Normalization ops.
+
+RMSNorm as used by the Llama family (fms ``LayerNormParameterized`` with
+elementwise scale, no bias, no mean subtraction). Statistics are computed in
+fp32 regardless of input dtype — on TPU the cast is free (VPU) and fp32
+accumulation avoids bf16 variance underflow — then the result is cast back.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x, weight, eps: float = 1e-5):
+    """y = x / rms(x) * weight, computed in fp32, returned in x.dtype."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)

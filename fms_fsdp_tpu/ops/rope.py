@@ -1,0 +1,46 @@
+"""Rotary position embeddings.
+
+The reference precomputes RoPE tables once up to ``max_expected_seq_len``
+(ref:main_training_llama.py:93-96) with per-variant ``rope_theta``
+(ref:fms_fsdp/utils/config_utils.py:43,74). We do the same: tables are a
+small (S, head_dim/2) cos/sin pair computed in fp32 at trace time (constant-
+folded by XLA) and applied with the half-split ("rotate_half") convention —
+the same layout HF Llama uses, so weight export needs no q/k permutation
+(the reference needs one because fms stores interleaved pairs,
+ref:fms_to_hf_llama.py:69-124).
+"""
+
+import jax.numpy as jnp
+
+
+def rope_table(seq_len: int, head_dim: int, theta: float = 10000.0):
+    """Return (cos, sin), each (seq_len, head_dim // 2), fp32."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, freqs)  # (S, half)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rotary(x, cos, sin, positions=None):
+    """Apply half-split rotary embedding.
+
+    x: (..., S, n_heads, head_dim); cos/sin: (S_table, head_dim/2) fp32.
+    positions: optional (..., S) int positions into the table (for packed or
+    decode-time use); default = arange(S).
+    """
+    seq_len = x.shape[-3]
+    if positions is None:
+        c = cos[:seq_len]  # (S, half)
+        s = sin[:seq_len]
+        c = c[:, None, :]  # (S, 1, half) broadcasting over heads
+        s = s[:, None, :]
+    else:
+        c = cos[positions][..., None, :]
+        s = sin[positions][..., None, :]
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out1 = x1 * c - x2 * s
+    out2 = x2 * c + x1 * s
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

@@ -1,0 +1,29 @@
+from fms_fsdp_tpu.parallel.ac import parse_ac_fraction, selective_ac_mask
+from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+from fms_fsdp_tpu.parallel.mixed_precision import (
+    DtypePolicy,
+    bfSixteen,
+    bfSixteen_working,
+    fp32_policy,
+    get_dtype_policy,
+)
+from fms_fsdp_tpu.parallel.sharding import (
+    batch_pspec,
+    llama_param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "DtypePolicy",
+    "bfSixteen",
+    "bfSixteen_working",
+    "fp32_policy",
+    "get_dtype_policy",
+    "selective_ac_mask",
+    "parse_ac_fraction",
+    "llama_param_specs",
+    "batch_pspec",
+    "shard_params",
+]

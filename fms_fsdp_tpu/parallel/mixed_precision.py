@@ -1,0 +1,71 @@
+"""Mixed precision (dtype) policies.
+
+The reference's FSDP ``MixedPrecision`` presets
+(ref:fms_fsdp/policies/mixed_precision.py:5-27):
+
+- ``bfSixteen``          param bf16 / reduce bf16 / buffer bf16 — but FSDP
+  keeps the fp32 sharded master copy for the optimizer. TPU equivalent:
+  params + optimizer state fp32, cast to bf16 on entry to the forward,
+  gradients reduce in bf16 and are accumulated to fp32 for the update.
+- ``bfSixteen_working``  params genuinely bf16, reduce fp32.
+- ``fpSixteen``          fp16 variant (CUDA fallback; on TPU bf16 is always
+  available so this exists only for completeness).
+- ``fp32_policy``        everything fp32.
+
+On TPU this is a pure dtype policy — there is no wrapper machinery; casts
+happen inside the jitted step and XLA fuses them into adjacent ops.
+
+``reduce_dtype`` note: with GSPMD the cross-device gradient reduction runs
+in the dtype the gradient has at the point XLA inserts the collective —
+for the bfSixteen policy that is bf16 (the reduce-scatter mirrors the
+forward's bf16 all-gather), matching the reference preset. It is recorded
+here for parity/reporting; the train step additionally casts gradients to
+``param_dtype`` before the optimizer so Adam math always runs in the
+storage precision.
+"""
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class DtypePolicy:
+    param_dtype: jnp.dtype = jnp.float32  # storage (and optimizer) dtype
+    compute_dtype: jnp.dtype = jnp.bfloat16  # matmul / activation dtype
+    reduce_dtype: jnp.dtype = jnp.bfloat16  # gradient cross-device reduction
+
+
+bfSixteen = DtypePolicy(
+    param_dtype=jnp.float32,
+    compute_dtype=jnp.bfloat16,
+    reduce_dtype=jnp.bfloat16,
+)
+
+bfSixteen_working = DtypePolicy(
+    param_dtype=jnp.bfloat16,
+    compute_dtype=jnp.bfloat16,
+    reduce_dtype=jnp.float32,
+)
+
+fpSixteen = DtypePolicy(
+    param_dtype=jnp.float32,
+    compute_dtype=jnp.float16,
+    reduce_dtype=jnp.float16,
+)
+
+fp32_policy = DtypePolicy(
+    param_dtype=jnp.float32,
+    compute_dtype=jnp.float32,
+    reduce_dtype=jnp.float32,
+)
+
+
+def get_dtype_policy(cfg) -> DtypePolicy:
+    """Map train config -> policy (ref:train_utils.py:192-214 chooses
+    bfSixteen whenever bf16 is supported; on TPU it always is)."""
+    if not getattr(cfg, "mixed_precision", True):
+        return fp32_policy
+    if getattr(cfg, "pure_bf16", False):
+        return bfSixteen_working
+    return bfSixteen

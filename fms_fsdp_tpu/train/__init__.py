@@ -1,0 +1,15 @@
+from fms_fsdp_tpu.train.step import (
+    cross_entropy_loss,
+    get_lr_schedule,
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+)
+
+__all__ = [
+    "cross_entropy_loss",
+    "get_lr_schedule",
+    "init_train_state",
+    "make_optimizer",
+    "make_train_step",
+]

@@ -1,0 +1,58 @@
+"""Model FLOPs accounting for MFU/HFU reporting.
+
+The reference publishes MFU/HFU per the PaLM appendix-B convention
+(ref:README.md:22-30). Same convention here:
+
+- matmul params contribute 2 FLOPs/param/token forward (embedding gather
+  contributes none; the lm_head matmul counts);
+- causal attention contributes 2 * S * d_attn FLOPs/token/layer forward
+  (QK^T and PV, halved for causality);
+- backward = 2x forward; train = 3x forward;
+- HFU additionally counts recomputed forward FLOPs for remat'ed blocks.
+"""
+
+from fms_fsdp_tpu.models.configs import LlamaConfig
+
+
+def llama_matmul_params(cfg: LlamaConfig) -> int:
+    """Params participating in matmuls (everything but the embedding table)."""
+    return cfg.n_params(include_embeddings=False) + cfg.src_vocab_size * cfg.emb_dim
+
+
+def llama_fwd_flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    mm = 2 * llama_matmul_params(cfg)
+    attn_dim = cfg.nheads * cfg.head_dim
+    attn = cfg.nlayers * 2 * seq_len * attn_dim  # causal: S/2 keys avg, x4
+    return mm + attn
+
+
+def llama_train_flops_per_token(
+    cfg: LlamaConfig, seq_len: int, ac_fraction: float = 0.0
+) -> float:
+    """Model FLOPs (MFU numerator) per token for fwd+bwd.
+
+    ``ac_fraction`` > 0 gives the HFU numerator: remat'ed blocks replay
+    their forward in the backward pass.
+    """
+    fwd = llama_fwd_flops_per_token(cfg, seq_len)
+    return fwd * (3 + ac_fraction)
+
+
+# Peak dense bf16 TFLOP/s per chip.
+TPU_PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(kind_hint: str = "") -> float:
+    import os
+
+    hint = (kind_hint or os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")).lower()
+    for k, v in TPU_PEAK_FLOPS.items():
+        if k in hint:
+            return v
+    return TPU_PEAK_FLOPS["v5e"]

@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+The reference tests distributed behavior single-process by parameterizing
+(rank, worldsize) (ref:tests/test_datasets.py). We go further — JAX can
+simulate an 8-device mesh on CPU, so sharding/collective correctness is
+unit-testable (SURVEY.md §4 implication).
+"""
+
+import os
+import sys
+
+# The session environment pins JAX_PLATFORMS to the TPU platform; tests
+# always run on the virtual CPU mesh, so override unconditionally.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# jax may already be imported (site customization registers the TPU PJRT
+# plugin at interpreter start), in which case it captured JAX_PLATFORMS at
+# import time — override via config before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,125 @@
+"""Llama model unit tests: shapes, causality, scan/unroll equivalence,
+variant registry parity with the reference table
+(ref:fms_fsdp/utils/config_utils.py:25-161)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fms_fsdp_tpu.models.configs import LlamaConfig
+from fms_fsdp_tpu.models.llama import init_llama_params, llama_forward, param_count
+from fms_fsdp_tpu.utils.config_utils import get_model_config
+
+TINY = LlamaConfig(
+    src_vocab_size=257,
+    emb_dim=64,
+    nheads=4,
+    kvheads=2,
+    nlayers=4,
+    hidden_grow_factor=8 / 3,
+    multiple_of=16,
+    max_expected_seq_len=64,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_llama_params(jax.random.PRNGKey(0), TINY)
+
+
+def test_forward_shape_and_dtype(tiny_params):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, TINY.src_vocab_size)
+    logits = llama_forward(tiny_params, tokens, TINY, attn_impl="xla")
+    assert logits.shape == (2, 16, TINY.src_vocab_size)
+    assert logits.dtype == jnp.float32
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_causality(tiny_params):
+    """Changing token t+k must not change logits at positions <= t."""
+    key = jax.random.PRNGKey(2)
+    tokens = jax.random.randint(key, (1, 16), 0, TINY.src_vocab_size)
+    logits = llama_forward(
+        tiny_params, tokens, TINY, attn_impl="xla", compute_dtype=jnp.float32
+    )
+    perturbed = tokens.at[0, 10].set((tokens[0, 10] + 1) % TINY.src_vocab_size)
+    logits2 = llama_forward(
+        tiny_params, perturbed, TINY, attn_impl="xla", compute_dtype=jnp.float32
+    )
+    np.testing.assert_allclose(logits[0, :10], logits2[0, :10], atol=1e-5)
+    assert not np.allclose(logits[0, 10:], logits2[0, 10:])
+
+
+def test_scan_unroll_equivalence(tiny_params):
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, TINY.src_vocab_size)
+    a = llama_forward(
+        tiny_params, tokens, TINY, scan_layers=True, compute_dtype=jnp.float32,
+        attn_impl="xla",
+    )
+    b = llama_forward(
+        tiny_params, tokens, TINY, scan_layers=False, compute_dtype=jnp.float32,
+        attn_impl="xla",
+    )
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_remat_matches_plain(tiny_params):
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (1, 8), 0, TINY.src_vocab_size)
+
+    def loss(params, mask):
+        out = llama_forward(
+            params, tokens, TINY, ac_mask=mask, compute_dtype=jnp.float32,
+            attn_impl="xla",
+        )
+        return (out.astype(jnp.float32) ** 2).mean()
+
+    g_plain = jax.grad(loss)(tiny_params, None)
+    g_full = jax.grad(loss)(tiny_params, [True] * 4)
+    g_frac = jax.grad(loss)(tiny_params, [False, True, False, True])
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_full)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_frac)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_variant_registry():
+    """Spot-check the reference variant table's derived dimensions."""
+    c7 = get_model_config("llama2_7b")
+    assert (c7.emb_dim, c7.nheads, c7.n_kv_heads, c7.nlayers) == (4096, 32, 32, 32)
+    assert c7.hidden_dim == 11008
+    assert abs(c7.n_params() / 1e9 - 6.74) < 0.05
+
+    c70 = get_model_config("llama2_70b")
+    assert (c70.nheads, c70.n_kv_heads, c70.nlayers) == (64, 8, 80)
+    assert c70.hidden_dim == 28672
+    assert abs(c70.n_params() / 1e9 - 68.98) < 0.5
+
+    c8b = get_model_config("llama3_8b")
+    assert c8b.src_vocab_size == 128256
+    assert c8b.hidden_dim == 14336
+    assert c8b.rope_theta == 500000.0
+    assert get_model_config("llama3_8b_4k").max_expected_seq_len == 4096
+
+    c34 = get_model_config("llama2_34b")
+    assert c34.max_expected_seq_len == 16384 and c34.rope_theta == 1000000.0
+
+    with pytest.raises(ValueError):
+        get_model_config("nope")
+
+
+def test_param_count_matches_formula(tiny_params):
+    assert param_count(tiny_params) == TINY.n_params()
+
+
+def test_gqa_grouping(tiny_params):
+    """GQA (kv < q heads) must differ from broadcasting value heads wrongly:
+    just check kv head shapes flow and outputs are finite."""
+    cfg = LlamaConfig(
+        src_vocab_size=64, emb_dim=32, nheads=4, kvheads=1, nlayers=2, multiple_of=8
+    )
+    params = init_llama_params(jax.random.PRNGKey(5), cfg)
+    assert params["layers"]["wk"].shape == (2, 32, 1 * 8)
+    tokens = jnp.arange(12)[None, :] % 64
+    out = llama_forward(params, tokens, cfg, attn_impl="xla")
+    assert np.isfinite(np.asarray(out)).all()

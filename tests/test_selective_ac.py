@@ -1,0 +1,32 @@
+"""Selective-AC mask parity with the reference
+(ref:tests/test_selective_ac.py:12-64): for each fraction p, the per-block
+remat pattern over a 15-layer model must match exactly."""
+
+import pytest
+
+from fms_fsdp_tpu.parallel.ac import parse_ac_fraction, selective_ac_mask
+
+CASES = [
+    (0, [False] * 15),
+    (1 / 100, [False] * 15),
+    (1 / 5, [False, False, True, False, False] * 3),
+    (1 / 3, [False, True, False] * 5),
+    (1 / 2, [True, False] * 7 + [True]),
+    (3 / 5, [True, False, True, False, True] * 3),
+    (2 / 3, [True, False, True] * 5),
+    (1, [True] * 15),
+    (5 / 3, [True] * 15),
+    (-1, [False] * 15),
+]
+
+
+@pytest.mark.parametrize("p,expected", CASES)
+def test_selective_ac_mask(p, expected):
+    assert selective_ac_mask(15, p) == expected
+
+
+def test_fraction_strings():
+    # CLI delivers fractions as strings (ref:ac_handler.py:45-47)
+    assert selective_ac_mask(15, "1/3") == [False, True, False] * 5
+    assert parse_ac_fraction("2/3") == pytest.approx(2 / 3)
+    assert parse_ac_fraction(0.5) == 0.5

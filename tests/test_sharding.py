@@ -1,0 +1,145 @@
+"""Mesh + sharding + end-to-end train-step tests on the 8-device CPU mesh.
+
+Verifies the jax.sharding replacement for the reference's FSDP/HSDP/DDP
+trichotomy (ref:train_utils.py:227-234): mesh shapes, param placement, and
+that the full jitted train step runs and learns under each strategy.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from fms_fsdp_tpu.config import TrainConfig
+from fms_fsdp_tpu.models.configs import LlamaConfig
+from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+from fms_fsdp_tpu.parallel.sharding import (
+    infer_state_specs,
+    llama_param_specs,
+    resolve_spec,
+)
+from fms_fsdp_tpu.train.step import (
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+)
+
+TINY = LlamaConfig(
+    src_vocab_size=256,
+    emb_dim=64,
+    nheads=4,
+    kvheads=2,
+    nlayers=2,
+    multiple_of=16,
+    max_expected_seq_len=64,
+)
+
+
+def _cfg(**kw):
+    base = dict(
+        model_variant="tiny",
+        seq_length=16,
+        batch_size=2,
+        num_steps=100,
+        learning_rate=1e-2,
+        report_interval=10,
+        vocab_size=256,
+        attention_kernel="xla",
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def test_mesh_shapes():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    m = build_mesh(MeshConfig(sharding_strategy="fsdp"))
+    assert dict(m.shape) == {"replica": 1, "fsdp": 8, "context": 1, "tensor": 1}
+    m = build_mesh(MeshConfig(sharding_strategy="ddp"))
+    assert dict(m.shape) == {"replica": 8, "fsdp": 1, "context": 1, "tensor": 1}
+    m = build_mesh(MeshConfig(sharding_strategy="hsdp", sharding_group_size=4))
+    assert dict(m.shape) == {"replica": 2, "fsdp": 4, "context": 1, "tensor": 1}
+    m = build_mesh(MeshConfig(sharding_strategy="fsdp", tensor_parallel_size=2))
+    assert dict(m.shape) == {"replica": 1, "fsdp": 4, "context": 1, "tensor": 2}
+    m = build_mesh(
+        MeshConfig(sharding_strategy="fsdp", context_parallel_size=2)
+    )
+    assert dict(m.shape) == {"replica": 1, "fsdp": 4, "context": 2, "tensor": 1}
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(sharding_strategy="hsdp", sharding_group_size=3))
+
+
+def test_resolve_spec_divisibility():
+    mesh = build_mesh(MeshConfig(sharding_strategy="fsdp"))
+    # 64 divisible by 8 -> kept; 30 not -> dropped
+    assert resolve_spec(P("fsdp", None), (64, 3), mesh) == P("fsdp", None)
+    assert resolve_spec(P("fsdp", None), (30, 3), mesh) == P(None, None)
+
+
+def test_state_spec_inference():
+    cfg = _cfg(sharding_strategy="fsdp")
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    opt = make_optimizer(cfg)
+    state, shardings = init_train_state(
+        jax.random.PRNGKey(0), TINY, cfg, mesh, opt
+    )
+    # params sharded over fsdp on the model dim
+    wq_spec = state["params"]["layers"]["wq"].sharding.spec
+    assert wq_spec[1] == "fsdp"
+    # adam mu mirrors the param sharding
+    mu = state["opt_state"][1][0].mu["layers"]["wq"]
+    assert mu.sharding.spec == state["params"]["layers"]["wq"].sharding.spec
+    # scalar step replicated
+    assert state["step"].sharding.spec == P()
+
+
+@pytest.mark.parametrize(
+    "strategy,extra",
+    [
+        ("ddp", {}),
+        ("fsdp", {}),
+        ("hsdp", {"sharding_group_size": 4}),
+        ("fsdp", {"tensor_parallel_size": 2}),
+    ],
+)
+def test_train_step_learns(strategy, extra):
+    cfg = _cfg(sharding_strategy=strategy, **{k: v for k, v in extra.items()})
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    opt = make_optimizer(cfg)
+    state, _ = init_train_state(jax.random.PRNGKey(0), TINY, cfg, mesh, opt)
+    step_fn = make_train_step(TINY, cfg, mesh, opt)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 256, size=(8, 17))
+    inputs = jnp.asarray(tokens[:, :-1], jnp.int32)
+    labels = jnp.asarray(tokens[:, 1:], jnp.int32)
+    labels = labels.at[:, 0].set(-100)  # causal_lm prompt masking analog
+
+    losses = []
+    for _ in range(20):
+        state, metrics = step_fn(state, (inputs, labels))
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    # memorizing one batch must drive loss down hard
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert float(metrics["gnorm"]) > 0
+    assert int(state["step"]) == 20
+
+
+def test_strategies_agree():
+    """ddp and fsdp are the same math — first-step loss must match."""
+    results = {}
+    for strategy in ["ddp", "fsdp"]:
+        cfg = _cfg(sharding_strategy=strategy)
+        mesh = build_mesh(MeshConfig.from_train_config(cfg))
+        opt = make_optimizer(cfg)
+        state, _ = init_train_state(jax.random.PRNGKey(0), TINY, cfg, mesh, opt)
+        step_fn = make_train_step(TINY, cfg, mesh, opt)
+        rng = np.random.default_rng(1)
+        tokens = rng.integers(0, 256, size=(8, 17))
+        inputs = jnp.asarray(tokens[:, :-1], jnp.int32)
+        labels = jnp.asarray(tokens[:, 1:], jnp.int32)
+        for _ in range(3):
+            state, metrics = step_fn(state, (inputs, labels))
+        results[strategy] = float(metrics["loss"])
+    assert results["ddp"] == pytest.approx(results["fsdp"], rel=2e-2)

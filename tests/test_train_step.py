@@ -1,0 +1,68 @@
+"""LR schedule and loss parity tests against the reference formulas
+(ref:main_training_llama.py:137-148, ref:train_utils.py:90-91)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from fms_fsdp_tpu.config import TrainConfig
+from fms_fsdp_tpu.train.step import cross_entropy_loss, get_lr_schedule
+
+
+def reference_schedule(x, num_steps):
+    """Literal transcription of the reference lambda for comparison."""
+    warmup_interval = min(2000, num_steps // 20)
+    return min(
+        1 - (1 - min(x, warmup_interval) / warmup_interval) ** 2,
+        0.1 + 0.5 * (1 - 0.1) * (1 + math.cos(min(x, num_steps) / num_steps * math.pi)),
+    )
+
+
+def test_lr_schedule_initial_stage():
+    cfg = TrainConfig(num_steps=100000, learning_rate=3e-4)
+    sched = get_lr_schedule(cfg)
+    for x in [0, 1, 10, 500, 1999, 2000, 2001, 30000, 60000, 99999, 100000]:
+        expected = 3e-4 * reference_schedule(x, 100000)
+        # schedule evaluates in fp32 on device; allow fp32 rounding
+        assert float(sched(x)) == pytest.approx(expected, rel=1e-3), x
+
+
+def test_lr_schedule_annealing():
+    cfg = TrainConfig(num_steps=1000, learning_rate=3e-4, training_stage="annealing")
+    sched = get_lr_schedule(cfg)
+    for x in [0, 1, 500, 999]:
+        assert float(sched(x)) == pytest.approx(3e-4 * (1 - x / 1000), rel=1e-6)
+
+
+def test_lr_schedule_start_step_offset():
+    cfg = TrainConfig(num_steps=100000, learning_rate=3e-4)
+    assert float(get_lr_schedule(cfg, start_step=5000)(0)) == pytest.approx(
+        float(get_lr_schedule(cfg)(5000)), rel=1e-6
+    )
+
+
+def test_cross_entropy_matches_torch():
+    """Same semantics as CrossEntropyLoss()(logits.view(-1,V), labels.view(-1))
+    including ignore_index=-100 (ref:train_utils.py:90-91)."""
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(2, 5, 11)).astype(np.float32)
+    labels = rng.integers(0, 11, size=(2, 5))
+    labels[0, 0] = -100
+    labels[1, 3] = -100
+
+    ours = float(cross_entropy_loss(jnp.asarray(logits), jnp.asarray(labels)))
+    theirs = float(
+        torch.nn.CrossEntropyLoss()(
+            torch.tensor(logits).view(-1, 11), torch.tensor(labels).view(-1)
+        )
+    )
+    assert ours == pytest.approx(theirs, rel=1e-5)
+
+
+def test_cross_entropy_all_ignored():
+    logits = jnp.zeros((1, 3, 7))
+    labels = jnp.full((1, 3), -100)
+    assert float(cross_entropy_loss(logits, labels)) == 0.0
