@@ -82,9 +82,12 @@ def main():
     flops_per_token = llama_train_flops_per_token(model_cfg, cfg.seq_length)
     mfu = tokens_per_sec_chip * flops_per_token / peak_flops_per_chip()
 
+    import os
+
+    chip = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     baseline_mfu = 0.68  # reference Llama2-7B MFU on A100 (BASELINE.md)
     result = {
-        "metric": f"{variant} train MFU (bs=2 seq=4096, {n_chips}x v5e chip)",
+        "metric": f"{variant} train MFU (bs=2 seq=4096, {n_chips}x {chip} chip)",
         "value": round(mfu, 4),
         "unit": "MFU",
         "vs_baseline": round(mfu / baseline_mfu, 4),

@@ -8,10 +8,10 @@ Llama/Mamba pretraining harness on PyTorch FSDP) designed TPU-first:
   FSDP FlatParameter runtime,
 - one jitted train step (fwd / loss / bwd / clip / update) instead of
   ``torch.compile`` + eager glue,
-- Pallas kernels for flash attention and the Mamba selective scan
-  instead of CUDA/Triton,
 - a stateful, rescalable streaming dataloader (host-side, numpy)
-  matching the reference's checkpoint/resume/rescale semantics.
+  matching the reference's checkpoint/resume/rescale semantics,
+- TPU kernels (Pallas) for the hot ops where XLA's defaults fall short
+  (see ops/ — the dispatchers fall back to XLA when a kernel is absent).
 
 Reference behavior studied from /root/reference (fms-fsdp); citations in
 docstrings use the form ``ref:<path>:<lines>``.

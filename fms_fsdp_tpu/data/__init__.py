@@ -1,0 +1,40 @@
+from fms_fsdp_tpu.data.buffering import (
+    BufferDataset,
+    CheckpointDataset,
+    PreloadBufferDataset,
+    PreprocessDataset,
+)
+from fms_fsdp_tpu.data.handlers import ArrowHandler, AutoHandler, ParquetHandler
+from fms_fsdp_tpu.data.loader import (
+    StatefulDataLoader,
+    causal_lm,
+    get_data_loader,
+    get_dummy_loader,
+    parse_data_args,
+)
+from fms_fsdp_tpu.data.stateful import StatefulDataset, WrapperDataset
+from fms_fsdp_tpu.data.streaming import (
+    SamplingDataset,
+    ScalableShardDataset,
+    StreamingDocDataset,
+)
+
+__all__ = [
+    "ArrowHandler",
+    "AutoHandler",
+    "ParquetHandler",
+    "BufferDataset",
+    "CheckpointDataset",
+    "PreloadBufferDataset",
+    "PreprocessDataset",
+    "SamplingDataset",
+    "ScalableShardDataset",
+    "StatefulDataLoader",
+    "StatefulDataset",
+    "StreamingDocDataset",
+    "WrapperDataset",
+    "causal_lm",
+    "get_data_loader",
+    "get_dummy_loader",
+    "parse_data_args",
+]

@@ -1,0 +1,276 @@
+"""Pipeline post-processing layers: packing, shuffling, mapping, and
+auto-checkpointing (ref:fms_fsdp/utils/dataset_utils.py:463-794).
+"""
+
+import logging
+import os
+import time
+from typing import Any, Callable, List
+
+import numpy as np
+
+from fms_fsdp_tpu.data.stateful import StatefulDataset, WrapperDataset
+from fms_fsdp_tpu.utils.ckpt_paths import get_latest
+
+logger = logging.getLogger(__name__)
+
+
+class PreprocessDataset(WrapperDataset):
+    """Apply a map function to every item of the wrapped stream."""
+
+    def __init__(self, dataset: StatefulDataset, aug_fn: Callable):
+        super().__init__(dataset)
+        self.aug_fn = aug_fn
+
+    def __iter__(self):
+        dataset = iter(self.dataset)
+        while True:
+            yield self.aug_fn(next(dataset))
+
+
+class BufferDataset(WrapperDataset):
+    """Pack variable-length sequences into fixed ``seq_len`` lines.
+
+    Greedy packing: pull until the line would overrun, split hard
+    (``pack_hard``) or pad out. Optionally injects bos at line start and eos
+    at line end, avoiding duplicates; a split token displaced by an injected
+    eos is pushed back onto the buffer. Rescales by dropping buffer state.
+    """
+
+    def __init__(
+        self,
+        dataset: StatefulDataset,
+        seq_len: int,
+        pack_hard: bool,
+        bos_token=None,
+        eos_token=None,
+        pad_token=None,
+    ):
+        super().__init__(dataset)
+        self.len = seq_len
+        self.buffer: List = []
+        self.bos = bos_token
+        self.eos = eos_token
+        self.pad = pad_token
+        self.pack_hard = pack_hard
+        if not pack_hard:
+            assert (
+                pad_token is not None
+            ), "Error: if using pads, you must supply a pad_token"
+        self.state_params = ["buffer"]
+
+    def _assemble_line(self, iterable, length, buffer):
+        """Return (line, leftover_buffer)."""
+        new = []
+        while len(buffer) + len(new) < length:
+            buffer += new
+            new = next(iterable)
+
+        if self.bos is not None and (len(buffer) == 0 or buffer[0] != self.bos):
+            buffer = [self.bos] + buffer
+
+        if len(buffer) >= length:
+            # split the overfull buffer at the line boundary
+            out = buffer[:length]
+            buffer = buffer[length:]
+            if self.eos is not None and out[-1] != self.eos:
+                buffer = [out[-1]] + buffer  # displaced token survives
+                out[-1] = self.eos
+            buffer = buffer + new
+        elif self.pack_hard:
+            # pack in as much of the new sequence as fits
+            buffer = buffer + new
+            out = buffer[:length]
+            buffer = buffer[length:]
+            if self.eos is not None and out[-1] != self.eos:
+                buffer = [out[-1]] + buffer
+                out[-1] = self.eos
+        else:
+            # pad out the line
+            if self.eos is not None and buffer[-1] != self.eos:
+                buffer.append(self.eos)
+            if self.pad is not None:
+                out = buffer + [self.pad] * (length - len(buffer))
+            else:
+                out = buffer
+            buffer = new
+        return out, buffer
+
+    def __iter__(self):
+        dataset = iter(self.dataset)
+        while True:
+            out, buffer = self._assemble_line(dataset, self.len, self.buffer)
+            self.buffer = buffer
+            yield out
+
+
+class PreloadBufferDataset(WrapperDataset):
+    """Shuffle via a ``window_size`` reservoir: fill the buffer, then emit a
+    uniformly random slot and refill it from the stream. Consecutive inputs
+    emerge ~window_size steps apart in expectation. Buffers reshard; an
+    oversized buffer (after down-scaling) drains back to window_size by
+    popping the tail into emitted slots."""
+
+    def __init__(self, dataset: StatefulDataset, window_size: int):
+        super().__init__(dataset)
+        assert window_size > 1, (
+            f"Window size {window_size} must be greater than 1 for shuffling"
+            " to occur"
+        )
+        self.window_size = window_size
+        self.g_state = None
+        self.generator = np.random.default_rng(self.rank)
+        self.buffer: List[List[Any]] = []
+        self.buffer_size = 0
+        self.state_params = ["g_state"]
+        self.reshard_params = ["buffer"]
+
+    def _pad_buffer(self):
+        if self.buffer_size < self.window_size:
+            self.buffer += [[]] * (self.window_size - self.buffer_size)
+
+    def __iter__(self):
+        dataset = iter(self.dataset)
+        while True:
+            self._pad_buffer()
+            # grow an undersized buffer
+            if self.buffer_size < self.window_size:
+                self.buffer[self.buffer_size] = next(dataset)
+                self.buffer_size += 1
+
+            i = int(self.generator.integers(self.buffer_size))
+            out = self.buffer[i]
+            if self.buffer_size > self.window_size:
+                # shrink an oversized (post-rescale) buffer
+                self.buffer[i] = self.buffer[self.buffer_size - 1]
+                self.buffer_size -= 1
+            else:
+                self.buffer[i] = next(dataset)
+            yield out
+
+    def state_dict(self):
+        self.g_state = self.generator.bit_generator.state
+        self.buffer = self.buffer[: self.buffer_size]
+        return super().state_dict()
+
+    def load_state_dict(self, state_dicts, sharded_input=False):
+        sharded_dicts = super().load_state_dict(state_dicts, sharded_input)
+        if self.g_state is not None:
+            self.generator = np.random.default_rng()
+            self.generator.bit_generator.state = self.g_state
+        self.buffer_size = len(self.buffer)
+        return sharded_dicts
+
+
+class CheckpointDataset(WrapperDataset):
+    """Auto-save the full pipeline state every ``interval`` complete batches
+    to ``<save_path>/checkpoints/step_N_ckp/loader_state_<rank>.pkl``, and
+    auto-load the newest valid checkpoint at setup (preferring the save
+    directory — a restarted job resumes itself; an external load path
+    resets the step count)."""
+
+    def __init__(
+        self,
+        dataset: StatefulDataset,
+        load_path: str,
+        interval: int,
+        steps_per_batch: int = 1,
+        save_path: str = "",
+    ):
+        super().__init__(dataset)
+        self.interval = interval
+        self.spb = steps_per_batch
+        load_path = os.path.join(load_path, "checkpoints")
+        if len(save_path) == 0:
+            save_path = load_path
+        else:
+            save_path = os.path.join(save_path, "checkpoints")
+        self.load_path = load_path
+        self.path = save_path
+        self.step = 0
+        self.ministep = 0
+
+    def setup(self):
+        if not self.is_setup:
+            super().setup()
+            self.load_from_path(self.load_path)
+
+    def __iter__(self):
+        self.setup()
+        dataset = iter(self.dataset)
+        while True:
+            out = next(dataset)
+            # count (and save) eagerly before yielding: without worker
+            # prefetch running ahead, a lazy post-yield count would delay
+            # the interval-N save until batch N+1 is pulled
+            self.ministep += 1
+            if self.ministep == self.spb:
+                self.ministep = 0
+                self.step += 1
+                if self.step % self.interval == 0:
+                    newpath = os.path.join(self.path, f"step_{self.step}_ckp")
+                    self.save_to_path(newpath)
+            yield out
+
+    def report(self, msg):
+        if self.rank == 0:
+            print(msg)
+
+    def _validate_ckp_path(self, path: str, verbose: bool = False):
+        """Resolve path to the newest complete checkpoint dir, or ''."""
+        if not os.path.exists(path) or len(os.listdir(path)) == 0:
+            if verbose:
+                self.report(
+                    f"  Dataset: No valid checkpoint detected at {path}, "
+                    "dataset starting from scratch."
+                )
+            return ""
+        latest = get_latest(path, key=lambda p: int(p.split("_")[-2]))
+        if verbose:
+            self.report(f"Checkpoint detected at {latest}")
+        if os.path.isfile(latest):
+            if verbose:
+                self.report(
+                    f"  Dataset: Detected checkpoint {latest} is a single"
+                    " file with no dataset info. Dataset starting from"
+                    " scratch."
+                )
+            return ""
+        if len([x for x in os.listdir(latest) if "loader" in x]) == 0:
+            if verbose:
+                self.report(
+                    f"  Dataset: Detected checkpoint {latest} exists but"
+                    " contains no dataset checkpoints. Dataset starting"
+                    " from scratch."
+                )
+            return ""
+        self.step = int(latest.split("_")[-2])
+        return latest
+
+    def save_to_path(self, path: str):
+        self.report(f"Saving dataset to {path}")
+        start = time.time()
+        super().save_to_path(path)
+        self.report(
+            f"Dataset successfully saved to {path}! "
+            f"Save time: {time.time() - start}"
+        )
+
+    def load_from_path(self, path: str):
+        # a checkpoint in the save dir means this job restarted: prefer it
+        save_path = self._validate_ckp_path(self.path, False)
+        if len(save_path) > 0:
+            self.report(
+                f"  Dataset: Detected a checkpoint in the save directory "
+                f"{save_path}. Restoring from this checkpoint."
+            )
+            path = save_path
+        else:
+            load_path = self._validate_ckp_path(self.load_path, True)
+            if len(load_path) == 0:
+                return
+            path = load_path
+            self.step = 0  # external checkpoint: step restarts
+        start = time.time()
+        self.dataset.load_from_path(path)
+        self.report(f"Dataset checkpoint loaded! Load time: {time.time() - start}")

@@ -1,0 +1,83 @@
+"""Host -> device feed: sharded global arrays with background prefetch.
+
+The reference hides data-pipeline latency behind torch DataLoader worker
+processes; on TPU the equivalent is (a) a background host thread running
+the (pure-python) pipeline, and (b) forming each batch directly into a
+``jax.Array`` sharded over the mesh's data axes so the jitted step consumes
+it with zero reshuffling. Double-buffering (prefetch >= 1) overlaps the
+next batch's host work and H2D copy with the current device step
+(SURVEY.md §7 hard part 5).
+"""
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from fms_fsdp_tpu.parallel.sharding import batch_pspec, resolve_spec
+
+
+def to_global_batch(batch, mesh: Mesh):
+    """Assemble a (tuple of) process-local numpy batch into global sharded
+    jax.Arrays laid out per batch_pspec over the mesh."""
+
+    def convert(arr):
+        arr = np.asarray(arr)
+        # global shape: concatenation of per-process batches on axis 0
+        gshape = (arr.shape[0] * jax.process_count(),) + arr.shape[1:]
+        sharding = NamedSharding(mesh, resolve_spec(batch_pspec(), gshape, mesh))
+        return jax.make_array_from_process_local_data(sharding, arr, gshape)
+
+    if isinstance(batch, tuple):
+        return tuple(convert(a) for a in batch)
+    return convert(batch)
+
+
+class DeviceFeed:
+    """Iterator over device-resident sharded batches with prefetch.
+
+    The host thread pulls from ``loader`` (the stateful pipeline) and stages
+    arrays onto devices; the consumer gets batches that are already placed.
+    ``prefetch=0`` degrades to synchronous operation (useful in tests).
+    """
+
+    def __init__(self, loader, mesh: Mesh, prefetch: int = 2):
+        self.loader = loader
+        self.mesh = mesh
+        self.prefetch = prefetch
+
+    def __iter__(self) -> Iterator:
+        if self.prefetch <= 0:
+            for batch in self.loader:
+                yield to_global_batch(batch, self.mesh)
+            return
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        err = []
+
+        def worker():
+            try:
+                for batch in self.loader:
+                    if stop.is_set():
+                        return
+                    q.put(to_global_batch(batch, self.mesh))
+            except BaseException as e:  # surface pipeline errors to consumer
+                err.append(e)
+                q.put(None)
+
+        t = threading.Thread(target=worker, daemon=True, name="device-feed")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()

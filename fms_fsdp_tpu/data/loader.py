@@ -1,0 +1,237 @@
+"""Pipeline assembly + batching loader
+(ref:fms_fsdp/utils/dataloader_utils.py:17-163).
+
+``StatefulDataLoader`` replaces torch's DataLoader: it stacks pipeline
+outputs into numpy batches and realizes ``num_workers`` as in-process
+logical sub-ranks — each worker is a full pipeline clone whose
+(rank, worldsize) is inflated exactly the way the reference inflates them
+inside torch worker processes (worldsize *= num_workers,
+rank = rank * num_workers + worker_id, ref:dataset_utils.py:108-119), with
+batches drawn round-robin across workers (torch IterableDataset semantics).
+Async host prefetch happens at the device-feed layer (device_feed.py),
+which is where TPU step-time overlap actually comes from.
+"""
+
+from copy import deepcopy
+from typing import Callable, List
+
+import numpy as np
+
+from fms_fsdp_tpu.data.buffering import (
+    BufferDataset,
+    CheckpointDataset,
+    PreloadBufferDataset,
+    PreprocessDataset,
+)
+from fms_fsdp_tpu.data.handlers import ArrowHandler, AutoHandler, ParquetHandler
+from fms_fsdp_tpu.data.streaming import (
+    SamplingDataset,
+    ScalableShardDataset,
+    StreamingDocDataset,
+)
+
+_HANDLER_BUILDERS = {
+    "arrow": lambda cfg: ArrowHandler(cfg.col_name),
+    "hf_parquet": lambda cfg: ParquetHandler(cfg.tokenizer_path, cfg.col_name),
+    "auto": lambda cfg: AutoHandler(cfg.tokenizer_path, cfg.col_name),
+}
+
+
+def causal_lm(data_seq, prompt_len: int = 1):
+    """Shift for next-token prediction: input = seq[:-1], label = seq[1:]
+    with the first ``prompt_len`` labels masked to -100
+    (ref:dataloader_utils.py:24-33)."""
+    data_seq = np.asarray(data_seq, dtype=np.int32)
+    t = data_seq[1:].copy()
+    data_seq = data_seq[:-1]
+    t[:prompt_len] = -100
+    return data_seq, t
+
+
+def _stack(items):
+    """Stack a list of items (arrays or tuples of arrays) into a batch."""
+    if isinstance(items[0], tuple):
+        return tuple(np.stack(field) for field in zip(*items))
+    return np.stack(items)
+
+
+class StatefulDataLoader:
+    """Batching iterator over one or more pipeline clones ("workers").
+
+    Exposes the wrapped pipeline as ``.dataset`` (parity with
+    ``torch_loader.dataset`` access in the reference checkpoint path,
+    ref:checkpointing_utils.py:275-278); with num_workers > 1 each worker
+    owns an inflated rank and saves its own ``loader_state_<rank>`` file.
+    """
+
+    def __init__(self, dataset, batch_size: int = 1, num_workers: int = 1):
+        self.batch_size = batch_size
+        self.num_workers = max(1, num_workers)
+        if self.num_workers == 1:
+            self.pipelines = [dataset]
+        else:
+            self.pipelines = []
+            for worker_id in range(self.num_workers):
+                clone = dataset if worker_id == self.num_workers - 1 else deepcopy(
+                    dataset
+                )
+                clone.local_worldsize = self.num_workers
+                clone.worldsize = clone.worldsize * self.num_workers
+                clone.rank = self.num_workers * clone.rank + worker_id
+                self.pipelines.append(clone)
+
+    @property
+    def dataset(self):
+        return self.pipelines[0]
+
+    def __iter__(self):
+        # Top-level setup propagates the (possibly worker-inflated)
+        # rank/worldsize down the wrapper stack before any layer iterates.
+        for p in self.pipelines:
+            p.setup()
+        iterators = [iter(p) for p in self.pipelines]
+        w = 0
+        while True:
+            items = [next(iterators[w]) for _ in range(self.batch_size)]
+            yield _stack(items)
+            w = (w + 1) % self.num_workers
+
+    # -- state (delegates to every worker pipeline) -----------------------
+
+    def state_dict(self) -> List[dict]:
+        return [p.state_dict() for p in self.pipelines]
+
+    def load_state_dict(self, state_dicts, sharded_input=False):
+        for p in self.pipelines:
+            p.load_state_dict(state_dicts, sharded_input)
+
+    def save_to_path(self, path: str):
+        for p in self.pipelines:
+            p.save_to_path(path)
+
+    def load_from_path(self, path: str):
+        for p in self.pipelines:
+            p.load_from_path(path)
+
+
+class SteadyCounter:
+    """Dummy stream: incrementing counts of constant length l mod vocab v
+    (ref:dataloader_utils.py:41-54). Used for benchmarking / dummy runs."""
+
+    def __init__(self, l: int, v: int):
+        self.i = 0
+        self.l = l
+        self.v = v
+
+    def __iter__(self):
+        while True:
+            out = np.arange(self.i, self.i + self.l, dtype=np.int32) % self.v
+            yield out, out
+            self.i += self.l
+
+
+class _SimpleLoader:
+    """Minimal batching loader for non-stateful iterables (dummy data)."""
+
+    def __init__(self, dataset, batch_size: int):
+        self.dataset = dataset
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        it = iter(self.dataset)
+        while True:
+            yield _stack([next(it) for _ in range(self.batch_size)])
+
+
+def get_dummy_loader(cfg, rank, world_size):
+    return _SimpleLoader(SteadyCounter(cfg.seq_length, cfg.vocab_size), cfg.batch_size)
+
+
+def get_data_loader(cfg, rank, world_size, postprocess=None):
+    """Build the full 7-layer pipeline
+    (ref:dataloader_utils.py:60-146): streaming docs -> logical-shard
+    rescaling -> weighted multi-dataset sampling -> fixed-length packing ->
+    reservoir shuffle -> tensorize -> task postprocess -> auto-checkpoint,
+    wrapped in the batching loader.
+    """
+    if postprocess is None:
+        postprocess = [causal_lm]
+
+    datasets, weights = parse_data_args(cfg.datasets, cfg.weights)
+
+    droplist = [
+        int(x.strip()) for x in cfg.strip_tokens.split(",") if len(x.strip()) > 0
+    ]
+    droplist = droplist + [cfg.bos_token, cfg.eos_token, cfg.bol_token, cfg.eol_token]
+    assert cfg.file_type in _HANDLER_BUILDERS, (
+        f"File type {cfg.file_type} is not recognized "
+        f"({list(_HANDLER_BUILDERS.keys())})"
+    )
+    filehandler = _HANDLER_BUILDERS[cfg.file_type](cfg)
+
+    data = StreamingDocDataset(
+        cfg.data_path,
+        rank,
+        world_size,
+        filehandler,
+        cfg.eos_token,
+        bos_token=cfg.bos_token,
+        strip_tokens=set(droplist),
+        min_length=3,
+        seed=cfg.seed,
+    )
+    data = ScalableShardDataset(
+        data,
+        cfg.eos_token,
+        n_logical_shards=cfg.logical_shards,
+    )
+    data = SamplingDataset(
+        cfg.data_path,
+        data,
+        cfg.eos_token,
+        datasets=datasets,
+        weights=weights,
+        verbose=(rank == 0),
+    )
+    # +1 token so the causal shift still yields seq_length-long examples
+    data = BufferDataset(
+        data,
+        cfg.seq_length if causal_lm not in postprocess else cfg.seq_length + 1,
+        bos_token=cfg.bol_token,
+        eos_token=cfg.eol_token,
+        pack_hard=True,
+    )
+    data = PreloadBufferDataset(data, 10000)
+
+    data = PreprocessDataset(data, lambda x: np.asarray(x, dtype=np.int32))
+    for p in postprocess:
+        data = PreprocessDataset(data, p)
+
+    data = CheckpointDataset(
+        data,
+        cfg.ckpt_load_path if cfg.resuming_dataset else cfg.ckpt_save_path,
+        cfg.checkpoint_interval,
+        cfg.batch_size,
+        cfg.ckpt_save_path,
+    )
+    return StatefulDataLoader(
+        data, batch_size=cfg.batch_size, num_workers=cfg.num_workers
+    )
+
+
+def parse_data_args(datas, weights):
+    """csv strings -> lists (ref:dataloader_utils.py:149-163)."""
+
+    def splitstrip(x):
+        if isinstance(x, str):
+            return [item.strip() for item in x.split(",")]
+        elif isinstance(x, (list, tuple)):
+            return list(x)
+        elif isinstance(x, (int, float, complex)):
+            return [x]
+        else:
+            raise ValueError(f"arg input {x} cannot be parsed.")
+
+    datas = splitstrip(datas)
+    weights = [float(x) for x in splitstrip(weights)]
+    return datas, weights

@@ -1,0 +1,553 @@
+"""Streaming document readers: the distribution-aware base of the pipeline.
+
+Three layers (ref:fms_fsdp/utils/dataset_utils.py:797-1417):
+
+- ``StreamingDocDataset`` — walks one dataset directory, partitions shard
+  files into worldsize fragments per worker (contiguous spans to limit
+  file churn), pulls documents via an LCG bijection shuffle (no doc-list
+  materialization), yields documents in chunks <= max_chunksize with
+  delimiter/bos placement, and tracks epoch/token/doc progress with
+  mid-document resume.
+- ``ScalableShardDataset`` — rescalability: clones the reader into
+  ``n_logical_shards`` logical workers; each physical rank owns
+  n/worldsize of them and samples among its logicals proportional to
+  docs remaining, so checkpoints reshard onto any world size dividing
+  the logical count.
+- ``SamplingDataset`` — multi-dataset weighted mixing by *tokens seen*:
+  always draws from the most under-target subdataset, holding it to a
+  document boundary.
+"""
+
+import csv
+import logging
+import math
+import os
+import random
+from copy import deepcopy
+from typing import Any, List, Optional, Set, Union
+
+import numpy as np
+
+from fms_fsdp_tpu.data.handlers import ShardFileHandler
+from fms_fsdp_tpu.data.stateful import (
+    StatefulDataset,
+    WrapperDataset,
+    shard_partition,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class StreamingDocDataset(StatefulDataset):
+    """Base reader for one dataset directory (need not be flat).
+
+    Document order: shard files are deterministically shuffled per worker;
+    within each owned shard fragment, documents are visited via an LCG
+    random bijection (a=5, c=(rank+seed)*2+1, power-of-2 modulus — Knuth
+    3.2.1.3) so shuffled traversal needs O(1) state and resumes exactly.
+    Documents stream out as chunks of at most ``max_chunksize`` tokens with
+    the delimiter appended at document end (and optional bos prepended),
+    so downstream layers can detect document boundaries.
+
+    Shard-file lengths come from a ``meta/*counts*.csv`` in the parent
+    directory when present, else each owned file is touched once.
+    """
+
+    def __init__(
+        self,
+        datapath: str,
+        rank: int,
+        worldsize: int,
+        filehandler: ShardFileHandler,
+        delimiter_token: Any,
+        bos_token: Optional[Any] = None,
+        strip_tokens: Optional[Set[Any]] = set(),
+        seed: int = 42,
+        min_length: int = 1,
+        max_chunksize: int = 1024,
+        verbose: bool = False,
+    ):
+        super().__init__(datapath, rank, worldsize)
+        self.seed = seed
+        self.datapath = datapath
+        self.filehandler = filehandler
+        self.min_length = min_length
+        assert max_chunksize > 0, "Max chunksize must be a nonzero positive integer"
+        self.chunksize = max_chunksize
+        self.eos = delimiter_token
+        self.bos = bos_token
+        self.drop = strip_tokens
+        self.verbose = verbose
+
+        # docset: list of (shard-relpath, min docid, max docid) owned spans
+        self.docset: List[Any] = []
+        self.docset_index = 0
+        self.chunk_index = -1
+
+        # progress stats
+        self.epochs_seen = -1
+        self.tokens_seen = 0
+        self.docs_seen = 0
+        self.percent_seen = 0
+
+        self.state_params = [
+            "dataset",
+            "docset_index",
+            "chunk_index",
+            "epochs_seen",
+            "tokens_seen",
+            "docs_seen",
+            "percent_seen",
+            "lcg_state",
+        ]
+
+        self.is_setup = False
+        self._len = 0
+        self.dataset = ""
+        self.lcg_state = 0
+
+    # -- setup ------------------------------------------------------------
+
+    def _walk_shards(self) -> List[str]:
+        shards = [
+            os.path.join(root, name)[len(self.datapath) + 1 :]
+            for root, dirs, files in os.walk(self.datapath, topdown=False)
+            for name in files
+            if self.filehandler.is_legal(os.path.join(root, name))
+        ]
+        shards.sort()  # identical ordering on every worker
+        return shards
+
+    def _load_doc_counts(self, pardir: str, dataset: str, shardfrags) -> dict:
+        """Document count per shard file: from the meta csv when present,
+        else by touching each owned file once."""
+        countfiles = []
+        metadir = os.path.join(pardir, "meta")
+        if os.path.exists(metadir):
+            countfiles = [
+                x for x in os.listdir(metadir) if "counts" in x and "csv" in x
+            ]
+        if countfiles:
+            doc_counts = {}
+            with open(os.path.join(metadir, countfiles[0]), "r") as csvfile:
+                for row in csv.DictReader(csvfile):
+                    fullpath = row["dataset/filename"]
+                    prefix = fullpath.find("/" + dataset) + 1
+                    if prefix > 0:
+                        key = fullpath[prefix + len(dataset) + 1 :]
+                        doc_counts[key] = int(row["documents"])
+            return doc_counts
+        return {
+            shard: self.filehandler.length(os.path.join(self.datapath, shard))
+            for shard in set(shard for shard, frag in shardfrags)
+        }
+
+    def setup(self):
+        if self.is_setup:
+            return
+        super().setup()
+        # dataset name = final path component (robust to trailing slashes)
+        pathsplit = (self.datapath, "")
+        while len(pathsplit[1]) == 0:
+            pathsplit = os.path.split(pathsplit[0])
+        pardir, dataset = pathsplit
+        self.dataset = dataset
+
+        # Fragment ownership: every shard file splits into worldsize
+        # fragments; the global fragment list (ordered by shard, then
+        # fragment) is cut into worldsize contiguous spans.
+        shards = self._walk_shards()
+        n = len(shards)
+        shardfrags = [
+            (shards[i // self.worldsize], i % self.worldsize)
+            for i in range(self.rank * n, (self.rank + 1) * n)
+        ]
+
+        doc_counts = self._load_doc_counts(pardir, dataset, shardfrags)
+
+        # Aggregate owned fragments into per-shard [min, max] doc spans.
+        spans = {}
+        for shard, frag in shardfrags:
+            ndocs = doc_counts[shard]
+            doc_start = (ndocs * frag) // self.worldsize
+            doc_end = (ndocs * frag + ndocs) // self.worldsize - 1  # inclusive
+            if shard not in spans:
+                spans[shard] = [doc_start, doc_end]
+            else:
+                spans[shard][0] = min(spans[shard][0], doc_start)
+                spans[shard][1] = max(spans[shard][1], doc_end)
+
+        doccount = 0
+        for shardid, (min_d, max_d) in spans.items():
+            self.docset.append((shardid, min_d, max_d))
+            doccount += max_d - min_d + 1
+        self._len = doccount
+
+        if self.verbose:
+            logger.info(
+                f"    Worker {self.rank} ingested {len(shardfrags)} shard "
+                f"fragments from {dataset}"
+            )
+
+        # Shard-file order shuffle + doc-shuffle seed, distinct per worker.
+        seed = self.seed + self.rank
+        random.Random(seed).shuffle(self.docset)
+        self.lcg_state = seed
+
+    # -- doc addressing ---------------------------------------------------
+
+    def _get_docid(self, i):
+        """Map a worker-global doc index to (shard, span length, span min)."""
+        cur = 0
+        assert i <= self._len, (
+            f"You have requested an illegal doc index {i}, "
+            f"docset length is {self._len}"
+        )
+        for shardid, min_d, max_d in self.docset:
+            cur += max_d - min_d + 1
+            if cur > i:
+                return shardid, max_d - min_d + 1, min_d
+
+    def _random_map_docid(self, size):
+        """Next within-span shuffled index from the LCG walk; states >= size
+        are skipped, giving a bijection over [0, size)."""
+        m = 2 ** math.ceil(math.log2(size))  # power-of-2 modulus
+        a = 5
+        c = (self.rank + self.seed) * 2 + 1
+        state = self.lcg_state
+        while True:
+            state = (a * state + c) % m
+            if state < size:
+                return state
+
+    # -- iteration --------------------------------------------------------
+
+    def _open_if_new(self, path, newpath, reader):
+        if newpath != path:
+            del reader
+            if self.verbose:
+                logger.info(f"Worker {self.rank} opening new file {newpath}")
+            return newpath, self.filehandler.open(newpath)
+        return path, reader
+
+    def _emit_chunk(self, j, doc, n_chunks):
+        """Chunk j of the doc, with bos on the first chunk and the delimiter
+        closing the last; accounts for the bos offset in slicing."""
+        start_index = j * self.chunksize
+        n_pull = self.chunksize
+        if self.bos is not None:
+            if j == 0:
+                n_pull -= 1
+            else:
+                start_index -= 1
+        chunk = self.filehandler.slice(doc, start_index, n_pull)
+        self.tokens_seen += len(chunk)
+        if self.bos is not None and j == 0:
+            chunk = [self.bos] + chunk
+        if j == n_chunks - 1:
+            chunk = chunk + [self.eos]
+        return chunk
+
+    def __iter__(self):
+        if not self.is_setup:
+            self.setup()
+        docset_offset = self.docset_index
+        lcg_offset = self.lcg_state
+        # chunks of the offset doc already emitted before checkpoint; they
+        # are replayed at the END of the epoch so the epoch stays exact
+        residual_chunks = self.chunk_index + 1
+        ndocs = self._len
+        path = ""
+        reader = None
+        while True:
+            for i in range(ndocs):
+                doc_index = (docset_offset + i) % ndocs
+                if doc_index == 0:
+                    self.epochs_seen += 1
+                self.docset_index = doc_index
+                shardid, docrange, mindoc = self._get_docid(doc_index)
+
+                newpath = os.path.join(self.datapath, shardid)
+                path, reader = self._open_if_new(path, newpath, reader)
+                doclcg = self._random_map_docid(docrange)
+                docid = doclcg + mindoc
+                doc = self.filehandler.get(reader, docid, self.drop)
+                if len(doc) == 0:
+                    continue
+                doclen = len(doc) + 1 if self.bos is None else len(doc) + 2
+                if doclen >= self.min_length:
+                    n_chunks = math.ceil(doclen / self.chunksize)
+                    for j in range(n_chunks):
+                        if i == 0 and j < residual_chunks:
+                            continue  # skipped now, replayed at epoch end
+                        self.chunk_index = j
+                        if j == n_chunks - 1:
+                            self.docs_seen += 1
+                            self.percent_seen = (
+                                self.docs_seen * 100 / (self._len + 1e-9)
+                            )
+                        yield self._emit_chunk(j, doc, n_chunks)
+
+                self.lcg_state = doclcg
+
+            # Epoch complete except the skipped residual chunks: rewind to
+            # the offset doc and emit them now.
+            self.docset_index = docset_offset
+            self.lcg_state = lcg_offset
+            shardid, docrange, mindoc = self._get_docid(docset_offset)
+            docid = self._random_map_docid(docrange) + mindoc
+            newpath = os.path.join(self.datapath, shardid)
+            path, reader = self._open_if_new(path, newpath, reader)
+            doc = self.filehandler.get(reader, docid, self.drop)
+            if len(doc) == 0:
+                continue
+            doclen = len(doc) + 1 if self.bos is None else len(doc) + 2
+            if doclen >= self.min_length:
+                n_chunks = math.ceil(doclen / self.chunksize)
+                for j in range(residual_chunks):
+                    self.chunk_index = j
+                    yield self._emit_chunk(j, doc, n_chunks)
+
+    def load_state_dict(self, state_dicts, sharded_input=False):
+        self.setup()
+        assert self.load_worldsize == self.worldsize, (
+            f"StreamingDocDataset does not support rescaling (ckp size: "
+            f"{self.load_worldsize}, world size: {self.worldsize}). "
+            "Please use a ScalableShardDataset."
+        )
+        d = self.dataset
+        out = super().load_state_dict(state_dicts, sharded_input)
+        assert d == self.dataset, (
+            f"Dataset mismatch: checkpoint contains {self.dataset}, expected {d}"
+        )
+        return out
+
+
+class ScalableShardDataset(WrapperDataset):
+    """Rescaling layer: the wrapped reader is cloned into ``n_logical_shards``
+    logical workers (rank i of n_logicals); this physical rank owns
+    n/worldsize of them and draws one document at a time from a logical
+    chosen ∝ docs-remaining, so data seen this epoch stays un-revisited
+    under any future world size dividing n_logicals."""
+
+    def __init__(
+        self,
+        dataset: StreamingDocDataset,
+        delimiter_token: Any,
+        n_logical_shards: int = 2048,
+        verbose=False,
+    ):
+        super().__init__(dataset)
+        assert n_logical_shards % self.worldsize == 0, (
+            f"World size {self.worldsize} must divide n_logical_shards "
+            f"{n_logical_shards} evenly"
+        )
+        assert (
+            n_logical_shards > 0
+        ), f"n_logical_shards {n_logical_shards} must be a positive integer"
+        self.total_shards = n_logical_shards
+        self.delimiter = delimiter_token
+        self.verbose = verbose
+
+        self.data: List[StreamingDocDataset] = []
+        self.logicals_owned: List[int] = []
+        self.n_logicals = 0
+        self.n_docs_remaining: List[int] = []
+        self.generator: Optional[np.random.Generator] = None
+
+        # Position state is meaningful only at unchanged world size; on
+        # rescale it is dropped with the other state_params.
+        self.current_reader = None
+        self.logical_shard_states = None
+        self.g_state = None
+
+        self.state_params = ["current_reader", "g_state"]
+        self.reshard_params = ["n_docs_remaining", "logical_shard_states"]
+
+    def setup(self):
+        if self.is_setup:
+            return
+        StatefulDataset.setup(self)
+        logicals = list(range(self.total_shards))
+        self.logicals_owned = shard_partition(logicals, self.rank, self.worldsize)
+        self.n_logicals = self.total_shards // self.worldsize
+        assert (
+            len(self.logicals_owned) == self.n_logicals
+        ), "(world size * num workers) does not divide logical shards evenly"
+
+        for i in range(self.n_logicals):
+            shard = deepcopy(self.dataset)
+            shard.worldsize = self.total_shards
+            shard.load_worldsize = self.total_shards
+            shard.rank = self.logicals_owned[i]
+            shard.local_worldsize = 1
+            shard.datapath = self.datapath
+            shard.verbose = self.rank == 0
+            self.data.append(shard)
+            if self.verbose:
+                logger.info(
+                    f"Worker {self.rank} assembled logical shard "
+                    f"{self.logicals_owned[i]}, {i + 1} of {self.n_logicals}"
+                )
+        for d in self.data:
+            d.setup()
+        self.n_docs_remaining = [d._len for d in self.data]
+        self.generator = np.random.default_rng(self.rank)
+
+    def _sample_logical(self) -> int:
+        weights = np.asarray(self.n_docs_remaining, dtype=np.float64)
+        total = weights.sum()
+        assert total > 0, f"No documents detected in {self.datapath}"
+        return int(self.generator.choice(len(weights), p=weights / total))
+
+    def __iter__(self):
+        self.setup()
+        data = [iter(d) for d in self.data]
+        while True:
+            if self.current_reader is not None:
+                ind = self.current_reader
+            else:
+                ind = self._sample_logical()
+            self.current_reader = ind
+            # stream one full document from the chosen logical
+            out = next(data[ind])
+            while out[-1] != self.delimiter:
+                yield out
+                out = next(data[ind])
+            self.current_reader = None
+            self.n_docs_remaining[ind] -= 1
+            if sum(self.n_docs_remaining) == 0:
+                # epoch boundary: reset counts and the sampling stream
+                self.n_docs_remaining = [d._len for d in self.data]
+                self.generator = np.random.default_rng(self.rank)
+            yield out
+
+    def state_dict(self):
+        self.setup()
+        self.g_state = self.generator.bit_generator.state
+        self.logical_shard_states = [d.state_dict() for d in self.data]
+        return StatefulDataset.state_dict(self)
+
+    def load_state_dict(self, state_dicts, sharded_input=False):
+        self.setup()
+        sharded_dicts = StatefulDataset.load_state_dict(
+            self, state_dicts, sharded_input
+        )
+        if self.g_state is not None:
+            self.generator = np.random.default_rng()
+            self.generator.bit_generator.state = self.g_state
+        for i in range(self.n_logicals):
+            self.data[i].load_state_dict([self.logical_shard_states[i]], True)
+        return sharded_dicts
+
+
+class SamplingDataset(WrapperDataset):
+    """Multi-dataset weighted mixing by tokens seen: each draw picks the
+    subdataset furthest below its target share and holds it through a full
+    document (delimiter detection)."""
+
+    def __init__(
+        self,
+        datapath: str,
+        dataset: Union[ScalableShardDataset, StreamingDocDataset],
+        delimiter_token: Any,
+        datasets=None,
+        weights=None,
+        verbose=False,
+    ):
+        super().__init__(dataset)
+        self.datapath = datapath
+        self.delimiter = delimiter_token
+        self.verbose = verbose
+        self.datasets = (
+            datasets
+            if datasets is not None
+            else [
+                f
+                for f in os.listdir(datapath)
+                if not os.path.isfile(os.path.join(datapath, f)) and "meta" not in f
+            ]
+        )
+        assert len(self.datasets) > 0, "You must specify at least one dataset"
+
+        if weights is not None:
+            assert len(weights) == len(self.datasets), (
+                f"Number of oversample weights {len(weights)} must match "
+                f"number of datasets {len(self.datasets)}"
+            )
+            for w in weights:
+                assert w > 0, f"Sampling rate {w} must be positive"
+        self.weights = [1] * len(self.datasets) if weights is None else weights
+        self.weights = [w / sum(self.weights) for w in self.weights]
+
+        self.tokens_seen = [0] * len(self.datasets)
+        self.current_iterator = -1
+        self.state_params = ["tokens_seen", "current_iterator"]
+
+    def setup(self):
+        if self.is_setup:
+            return
+        StatefulDataset.setup(self)
+        self.data = []
+        for i, d in enumerate(self.datasets):
+            clone = deepcopy(self.dataset)
+            clone.datapath = os.path.join(self.datapath, d)
+            clone.rank = self.rank
+            clone.worldsize = self.worldsize
+            clone.local_worldsize = self.local_worldsize
+            self.data.append(clone)
+            if self.verbose:
+                logger.info(
+                    f"Worker {self.rank} assembled subdataset iterator for "
+                    f"{d}, {i + 1} of {len(self.datasets)}"
+                )
+        for d in self.data:
+            d.setup()
+
+    def __iter__(self):
+        self.setup()
+        data = [iter(d) for d in self.data]
+        while True:
+            if self.current_iterator != -1:
+                # continue the current document
+                out = next(data[self.current_iterator])
+                self.tokens_seen[self.current_iterator] += len(out)
+                if out[-1] == self.delimiter:
+                    self.current_iterator = -1
+                yield out
+            else:
+                # most-undertarget subdataset next (ties -> higher index)
+                total = sum(self.tokens_seen) + 1e-9
+                offset = [
+                    self.weights[i] - self.tokens_seen[i] / total
+                    for i in range(len(self.datasets))
+                ]
+                self.current_iterator = max(
+                    (diff, i) for i, diff in enumerate(offset)
+                )[1]
+
+    def state_dict(self):
+        self.setup()
+        out = {
+            self.statename("sample_iterator_states"): [
+                d.state_dict() for d in self.data
+            ]
+        }
+        out.update(StatefulDataset.state_dict(self))
+        return out
+
+    def load_state_dict(self, state_dicts, sharded_input=False):
+        self.setup()
+        sharded_dicts = StatefulDataset.load_state_dict(
+            self, state_dicts, sharded_input
+        )
+        for i, subdata in enumerate(self.data):
+            subdata.load_worldsize = self.load_worldsize
+            subdata.load_state_dict(
+                [
+                    sd[self.statename("sample_iterator_states")][i]
+                    for sd in sharded_dicts
+                ],
+                True,
+            )
+        return sharded_dicts

@@ -22,15 +22,6 @@ import jax
 import jax.numpy as jnp
 
 
-def repeat_kv(k, n_rep: int):
-    """(B, S, Nkv, H) -> (B, S, Nkv*n_rep, H) by repeating each kv head."""
-    if n_rep == 1:
-        return k
-    b, s, nkv, h = k.shape
-    k = jnp.broadcast_to(k[:, :, :, None, :], (b, s, nkv, n_rep, h))
-    return k.reshape(b, s, nkv * n_rep, h)
-
-
 def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None):
     """Reference einsum attention with fp32 softmax."""
     b, sq, nq, h = q.shape

@@ -20,12 +20,10 @@ Axis order places "replica" outermost (slowest-varying = DCN on multi-slice)
 and "tensor" innermost (fastest ICI neighborhood).
 """
 
-import math
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import jax
-import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 

@@ -37,11 +37,6 @@ def batch_pspec() -> P:
     return P(DATA_AXES, AXIS_CONTEXT)
 
 
-def activation_pspec() -> P:
-    """Spec for (B, S, D) activations."""
-    return P(DATA_AXES, AXIS_CONTEXT, None)
-
-
 def llama_param_specs(scan: bool = True) -> Dict[str, Any]:
     """Spec tree matching the Llama param tree (models/llama.py).
 

@@ -78,15 +78,13 @@ def get_lr_schedule(cfg, start_step: int = 0):
 
 
 def make_optimizer(cfg, start_step: int = 0):
-    """clip-by-global-norm -> AdamW(0.9, 0.95, wd=0.1) with the LR schedule."""
-    return optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip_thresh),
-        optax.adamw(
-            learning_rate=get_lr_schedule(cfg, start_step),
-            b1=0.9,
-            b2=0.95,
-            weight_decay=0.1,
-        ),
+    """AdamW(0.9, 0.95, wd=0.1) with the LR schedule. Global-norm clipping
+    happens in the train step (fp32 norm, like torch clip_grad_norm_)."""
+    return optax.adamw(
+        learning_rate=get_lr_schedule(cfg, start_step),
+        b1=0.9,
+        b2=0.95,
+        weight_decay=0.1,
     )
 
 
@@ -173,7 +171,14 @@ def make_train_step(
         # Keep optimizer math in the storage dtype (fp32 master for the
         # bfSixteen policy); no-op when grads already match.
         grads = jax.tree.map(lambda g: g.astype(policy.param_dtype), grads)
-        gnorm = optax.global_norm(grads)
+        # Global-norm clip with the norm accumulated in fp32 regardless of
+        # grad dtype — matches torch clip_grad_norm_ (ref:train_utils.py:96);
+        # the pre-clip norm is the value the reference logs.
+        gnorm = optax.global_norm(
+            jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        )
+        clip_scale = jnp.minimum(1.0, cfg.grad_clip_thresh / (gnorm + 1e-6))
+        grads = jax.tree.map(lambda g: g * clip_scale.astype(g.dtype), grads)
         updates, opt_state = optimizer.update(
             grads, state["opt_state"], state["params"]
         )

@@ -1,0 +1,32 @@
+"""Checkpoint-directory path helpers (ref:fms_fsdp/utils/checkpointing_utils.py:23-64).
+
+Shared by the model Checkpointer and the dataloader's auto-checkpoint layer.
+"""
+
+import os
+
+
+def get_latest(targdir, qualifier=lambda x: True, key=os.path.getctime):
+    """Full path of the newest qualifying entry in targdir, or None."""
+    if os.path.exists(targdir) and len(os.listdir(targdir)) > 0:
+        candidates = [
+            os.path.join(targdir, x)
+            for x in os.listdir(targdir)
+            if qualifier(os.path.join(targdir, x))
+        ]
+        if candidates:
+            return max(candidates, key=key)
+    return None
+
+
+def get_oldest(targdir, qualifier=lambda x: True, key=os.path.getctime):
+    """Full path of the oldest qualifying entry in targdir, or None."""
+    if os.path.exists(targdir) and len(os.listdir(targdir)) > 0:
+        candidates = [
+            os.path.join(targdir, x)
+            for x in os.listdir(targdir)
+            if qualifier(os.path.join(targdir, x))
+        ]
+        if candidates:
+            return min(candidates, key=key)
+    return None

@@ -1,0 +1,54 @@
+"""Loader assembly, causal_lm shift, dummy loader, and device feed tests."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fms_fsdp_tpu.config import TrainConfig
+from fms_fsdp_tpu.data import causal_lm, get_dummy_loader, parse_data_args
+from fms_fsdp_tpu.data.device_feed import DeviceFeed
+from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def test_causal_lm_shift():
+    x, y = causal_lm(list(range(10)))
+    assert x.tolist() == list(range(9))
+    assert y[0] == -100  # first prompt_len labels masked
+    assert y[1:].tolist() == list(range(2, 10))
+    x, y = causal_lm(list(range(10)), prompt_len=3)
+    assert (y[:3] == -100).all()
+
+
+def test_parse_data_args():
+    d, w = parse_data_args("a, b ,c", "1,2.5,3")
+    assert d == ["a", "b", "c"]
+    assert w == [1.0, 2.5, 3.0]
+    d, w = parse_data_args(["x"], 5)
+    assert d == ["x"] and w == [5.0]
+    with pytest.raises(ValueError):
+        parse_data_args(None, "1")
+
+
+def test_dummy_loader():
+    cfg = TrainConfig(seq_length=8, vocab_size=16, batch_size=2)
+    it = iter(get_dummy_loader(cfg, 0, 1))
+    x, y = next(it)
+    assert x.shape == (2, 8)
+    assert np.array_equal(x, y)
+    x2, _ = next(it)
+    assert x2[0, 0] == 16 % 16  # stream continues mod vocab
+
+
+@pytest.mark.parametrize("prefetch", [0, 2])
+def test_device_feed(prefetch):
+    cfg = TrainConfig(seq_length=8, vocab_size=16, batch_size=8)
+    mesh = build_mesh(MeshConfig(sharding_strategy="fsdp"))
+    feed = DeviceFeed(get_dummy_loader(cfg, 0, 1), mesh, prefetch=prefetch)
+    it = iter(feed)
+    for _ in range(3):
+        x, y = next(it)
+        assert isinstance(x, jax.Array)
+        assert x.shape == (8, 8)
+        # batch dim sharded over the data axes
+        assert x.sharding.spec[0] == ("replica", "fsdp")
