@@ -78,10 +78,19 @@ def get_lr_schedule(cfg, start_step: int = 0):
 
 
 def make_optimizer(cfg, start_step: int = 0):
-    """AdamW(0.9, 0.95, wd=0.1) with the LR schedule. Global-norm clipping
-    happens in the train step (fp32 norm, like torch clip_grad_norm_)."""
-    return optax.adamw(
-        learning_rate=get_lr_schedule(cfg, start_step),
+    """AdamW(0.9, 0.95, wd=0.1). Global-norm clipping happens in the train
+    step (fp32 norm, like torch clip_grad_norm_).
+
+    The learning rate is *injected* each step from the schedule evaluated at
+    the train state's own step counter, not from optax's internal count —
+    so a non-resume load (continued pretraining / annealing over a restored
+    optimizer) restarts the schedule simply by resetting state["step"],
+    exactly like the reference's fresh LambdaLR over a loaded optimizer
+    (ref:main_training_llama.py:130-148).
+    """
+    del start_step
+    return optax.inject_hyperparams(optax.adamw)(
+        learning_rate=cfg.learning_rate,
         b1=0.9,
         b2=0.95,
         weight_decay=0.1,
@@ -179,14 +188,16 @@ def make_train_step(
         )
         clip_scale = jnp.minimum(1.0, cfg.grad_clip_thresh / (gnorm + 1e-6))
         grads = jax.tree.map(lambda g: g * clip_scale.astype(g.dtype), grads)
-        updates, opt_state = optimizer.update(
-            grads, state["opt_state"], state["params"]
+        lr = schedule(state["step"])
+        opt_state = state["opt_state"]._replace(
+            hyperparams=dict(state["opt_state"].hyperparams, learning_rate=lr)
         )
+        updates, opt_state = optimizer.update(grads, opt_state, state["params"])
         params = optax.apply_updates(state["params"], updates)
         metrics = {
             "loss": loss,
             "gnorm": gnorm,
-            "lr": schedule(state["step"]),
+            "lr": lr,
         }
         return (
             {"params": params, "opt_state": opt_state, "step": state["step"] + 1},

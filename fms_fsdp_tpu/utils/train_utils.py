@@ -1,0 +1,268 @@
+"""Host-side training loop, distributed setup, profiler, and trackers.
+
+The loop keeps the reference's observable behavior
+(ref:fms_fsdp/utils/train_utils.py:21-180): report cadence and metric
+names/semantics (loss, LR, gradient norm, tokens seen, memory,
+current/overall tokens-per-chip-per-sec, tokens-per-day), checkpoint
+cadence, resume semantics. TPU differences:
+
+- fwd/loss/bwd/clip/update is ONE jitted ``step_fn``; metric scalars stay
+  on device and are fetched only at report time, so the host never forces a
+  sync inside the hot window (XLA dispatch stays ahead of the device);
+- no explicit all_reduce of stats: loss/gnorm come out of the step already
+  globally reduced (jit over global arrays);
+- memory stats come from ``device.memory_stats()`` instead of CUDA.
+"""
+
+import os
+import time
+from dataclasses import asdict
+
+import jax
+
+
+def setup():
+    """Join the multi-host JAX world (NCCL-process-group analog,
+    ref:train_utils.py:183-184). Initializes on any multi-host signal:
+    an explicit coordinator, a multi-worker TPU pod env, or NUM_PROCESSES.
+    No-op on single-host runs (Orbax's multi-process commit protocol is
+    only needed — and only engaged — when process_count > 1)."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    multihost = (
+        os.environ.get("COORDINATOR_ADDRESS")
+        or int(os.environ.get("NUM_PROCESSES", "1")) > 1
+        or len([h for h in hostnames.split(",") if h.strip()]) > 1
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if multihost:
+        jax.distributed.initialize()
+
+
+def setup_environ_flags():
+    """Fail-loudly flags (ref:train_utils.py:187-189 analog)."""
+    os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+
+
+def get_tracker(cfg, rank: int):
+    """Optional wandb/aim tracker (ref:train_utils.py:34-73). Returns a
+    log_fn(dict, step) or None."""
+    if not cfg.tracker:
+        return None
+    if cfg.tracker not in ["wandb", "aim"]:
+        raise ValueError(f"tracker {cfg.tracker} not supported.")
+    if rank != 0:
+        return None
+    if cfg.tracker == "wandb":
+        try:
+            import wandb
+        except ImportError:
+            raise ImportError("tracker is set to wandb but wandb is not installed.")
+        print("--> wandb is enabled!")
+        wandb.init(
+            project=cfg.tracker_project_name,
+            dir=cfg.tracker_dir,
+            resume="allow",
+            id=cfg.tracker_run_id,
+        )
+        wandb.config = asdict(cfg)
+        return wandb.log
+    try:
+        from aim import Run
+    except ImportError:
+        raise ImportError("tracker is set to aim but aim is not installed.")
+    print("--> aim is enabled!")
+    run = Run(
+        experiment=cfg.tracker_project_name,
+        repo=cfg.tracker_dir,
+        run_hash=cfg.tracker_run_id,
+    )
+    run["hparams"] = asdict(cfg)
+    return run.track
+
+
+class WindowedProfiler:
+    """jax.profiler trace with the reference's windowing — skip ``wait``
+    steps, ``warmup`` more, capture ``active`` steps, once
+    (ref:train_utils.py:256-271: wait=1, warmup=2, active=3, repeat=1),
+    writing a TensorBoard-compatible XPlane trace to ``logdir``."""
+
+    def __init__(self, logdir="profile_traces", wait=1, warmup=2, active=3):
+        self.logdir = logdir
+        self.start_at = wait + warmup
+        self.stop_at = wait + warmup + active
+        self.count = 0
+        self._running = False
+
+    def step(self):
+        self.count += 1
+        if self.count == self.start_at and not self._running:
+            jax.profiler.start_trace(self.logdir)
+            self._running = True
+        elif self.count == self.stop_at and self._running:
+            jax.profiler.stop_trace()
+            self._running = False
+
+    def close(self):
+        """Finalize a trace left open by an early loop exit — an unflushed
+        XPlane buffer writes no usable profile."""
+        if self._running:
+            jax.profiler.stop_trace()
+            self._running = False
+
+
+def get_profiler(cfg, rank: int):
+    if not cfg.use_profiler:
+        return None
+    if cfg.profiler_rank0_only and rank != 0:
+        return None
+    return WindowedProfiler()
+
+
+def _memory_stats():
+    stats = jax.local_devices()[0].memory_stats() or {}
+    return stats.get("peak_bytes_in_use", 0), stats.get("bytes_in_use", 0)
+
+
+def train(
+    cfg,
+    state,
+    step_fn,
+    rank,
+    train_loader,
+    profiler,
+    checkpointer,
+    start_step,
+    tokens_seen,
+):
+    """Run the hot loop to cfg.num_steps. Returns the final reported loss."""
+    tracker_fn = get_tracker(cfg, rank)
+
+    world_size = (
+        jax.device_count()
+        // max(1, getattr(cfg, "tensor_parallel_size", 1))
+        // max(1, getattr(cfg, "context_parallel_size", 1))
+    )
+
+    # device-resident metric window; fetched only at report time
+    window = []
+    train_loss = -1.0
+    start = time.time()
+    loop_start = time.time()
+    new_tokens_seen = 0
+
+    try:
+        train_loss = _train_loop(
+            cfg,
+            state,
+            step_fn,
+            rank,
+            train_loader,
+            profiler,
+            checkpointer,
+            start_step,
+            tokens_seen,
+            tracker_fn,
+            world_size,
+        )
+    finally:
+        if profiler:
+            profiler.close()
+    return train_loss
+
+
+def _train_loop(
+    cfg,
+    state,
+    step_fn,
+    rank,
+    train_loader,
+    profiler,
+    checkpointer,
+    start_step,
+    tokens_seen,
+    tracker_fn,
+    world_size,
+):
+    window = []
+    train_loss = -1.0
+    start = time.time()
+    loop_start = time.time()
+    new_tokens_seen = 0
+
+    for batch_idx, batch in enumerate(train_loader, start=start_step + 1):
+        if batch_idx > cfg.num_steps:
+            break
+        state, metrics = step_fn(state, batch)
+        window.append(metrics)
+
+        if profiler:
+            profiler.step()
+
+        if batch_idx % cfg.report_interval == 0:
+            # one host sync per report interval
+            fetched = jax.device_get(window)
+            window = []
+            train_loss = float(
+                sum(m["loss"] for m in fetched) / max(1, len(fetched))
+            )
+            g_norm = float(sum(m["gnorm"] for m in fetched) / max(1, len(fetched)))
+            current_lr = float(fetched[-1]["lr"])
+            elapsed_time = time.time() - loop_start
+            new_tokens_seen = (
+                (batch_idx - start_step)
+                * world_size
+                * cfg.batch_size
+                * cfg.seq_length
+            )
+            if rank == 0:
+                total_tokens_seen = tokens_seen + new_tokens_seen
+                current_step_time = (time.time() - start) / cfg.report_interval
+                overall_step_time = elapsed_time / (batch_idx - start_step)
+                current_throughput = int(
+                    cfg.batch_size * cfg.seq_length / current_step_time
+                )
+                overall_throughput = int(
+                    cfg.batch_size * cfg.seq_length / overall_step_time
+                )
+                reserved_mem, allocated_mem = _memory_stats()
+
+                print("step:", batch_idx)
+                print("loss:", train_loss)
+                print("LR:", current_lr)
+                print("tokens seen:", total_tokens_seen)
+                print("gradient norm:", g_norm)
+                print("reserved memory:", reserved_mem)
+                print("allocated memory:", allocated_mem)
+                print("current step time:", current_step_time)
+                print("overall step time:", overall_step_time)
+                print("current token per gpu per sec:", current_throughput)
+                print("overall token per gpu per sec:", overall_throughput)
+                print(
+                    "overall token per day:",
+                    int(new_tokens_seen / elapsed_time * 3600 * 24),
+                )
+                if tracker_fn:
+                    tracker_fn(
+                        {
+                            "learning rate": current_lr,
+                            "loss": train_loss,
+                            "gradient norm": g_norm,
+                            "token seen": total_tokens_seen,
+                            "current throughput (token per gpu per sec)": current_throughput,
+                            "overall throughput (token per gpu per sec)": overall_throughput,
+                            "gpu reserved memory": reserved_mem,
+                            "gpu allocated memory": allocated_mem,
+                        },
+                        step=batch_idx,
+                    )
+            start = time.time()
+
+        if batch_idx % cfg.checkpoint_interval == 0 or batch_idx == cfg.num_steps:
+            checkpointer.save(
+                batch_idx,
+                state,
+                None,
+                tokens_seen=tokens_seen + new_tokens_seen,
+            )
+
+    return train_loss

@@ -1,0 +1,149 @@
+"""Llama pretraining entry point (ref:main_training_llama.py:25-175).
+
+Same orchestration sequence as the reference — config -> seed -> dist
+setup -> mesh/policies -> model -> dataloader -> sharded state -> ckpt
+load -> LR schedule -> profiler -> train — with the FSDP wrap, AC
+application, torch.compile, and optimizer construction all folded into
+the jitted train step + sharded init (train/step.py).
+
+Run:  python main_training_llama.py --model_variant=llama2_7b \\
+          --use_dummy_dataset=True --num_steps=100 ...
+"""
+
+import os
+import sys
+
+import jax
+
+from fms_fsdp_tpu.config import TrainConfig
+from fms_fsdp_tpu.data import get_data_loader, get_dummy_loader
+from fms_fsdp_tpu.data.device_feed import DeviceFeed
+from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+from fms_fsdp_tpu.train.step import (
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+)
+from fms_fsdp_tpu.utils.checkpointing import Checkpointer
+from fms_fsdp_tpu.utils.cli import parse_cli_args
+from fms_fsdp_tpu.utils.config_utils import get_model_config, update_config
+from fms_fsdp_tpu.utils.train_utils import (
+    get_profiler,
+    setup,
+    setup_environ_flags,
+    train,
+)
+
+
+def main(**kwargs):
+    cfg = TrainConfig()
+    update_config(cfg, **kwargs)
+
+    setup()
+    setup_environ_flags()
+
+    rank = jax.process_index()
+    world_size = jax.process_count()
+    if rank == 0:
+        print(f"--> running with these configs {cfg}")
+
+    # mesh (replaces FSDP wrapping/sharding policies)
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    data_extent = mesh.shape["replica"] * mesh.shape["fsdp"]
+    if rank == 0:
+        print(f"Sharding strategy = {cfg.sharding_strategy}, mesh = {dict(mesh.shape)}")
+
+    # model config; dotted CLI overrides (LlamaConfig.param=value) apply here
+    model_cfg = get_model_config(cfg.model_variant)
+    update_config(model_cfg, **kwargs)
+    if rank == 0:
+        print(f"\n--> model has {model_cfg.n_params() / 1e6} Million params\n")
+
+    # dataloader: per-process stream; batches cover this process's slice of
+    # the global batch (batch_size is per data-parallel rank, as in the
+    # reference)
+    if rank == 0:
+        print("Constructing datasets...")
+    if data_extent < world_size or data_extent % world_size != 0:
+        raise ValueError(
+            f"data-parallel extent {data_extent} (replica x fsdp) must be a "
+            f"positive multiple of process count {world_size}; lower "
+            "tensor/context parallel sizes or add devices"
+        )
+    local_batch = cfg.batch_size * (data_extent // world_size)
+    if not cfg.use_dummy_dataset:
+        loader = get_data_loader(cfg, rank, world_size)
+    else:
+        loader = get_dummy_loader(cfg, rank, world_size)
+    if rank == 0:
+        print("Datasets constructed!")
+
+    # sharded train state (jit-init directly into shards: the low_cpu_fsdp /
+    # meta-device analog, always on)
+    optimizer = make_optimizer(cfg)
+    state, _ = init_train_state(
+        jax.random.PRNGKey(cfg.seed), model_cfg, cfg, mesh, optimizer
+    )
+
+    # checkpoint load (continued pretraining or job restart)
+    checkpointer = Checkpointer(
+        cfg.ckpt_save_path, 1000, cfg.sharding_strategy, rank, 0
+    )
+    state, _, start_step, tokens_seen, is_resuming = checkpointer.load(
+        state,
+        None,
+        # a run-root load path points at its checkpoints/ subdir; a file
+        # path loads directly (ref:main_training_llama.py:124-127)
+        path=os.path.join(cfg.ckpt_load_path, "checkpoints/")
+        if not os.path.isfile(cfg.ckpt_load_path)
+        else cfg.ckpt_load_path,
+        strict=False,
+    )
+    if not is_resuming:
+        start_step = 0
+
+    step_fn = make_train_step(model_cfg, cfg, mesh, optimizer)
+
+    profiler = get_profiler(cfg, rank)
+
+    # batch loop: stack per-rank batches to the local device batch
+    feed = DeviceFeed(_rebatch(loader, local_batch, cfg.batch_size), mesh, prefetch=2)
+
+    if rank == 0:
+        print(f"Training for {cfg.num_steps} steps")
+    train(
+        cfg,
+        state,
+        step_fn,
+        rank,
+        iter(feed),
+        profiler,
+        checkpointer,
+        start_step,
+        tokens_seen,
+    )
+
+
+def _rebatch(loader, local_batch: int, batch_size: int):
+    """Concatenate loader batches (of per-rank batch_size) up to the
+    process-local device batch."""
+    if local_batch == batch_size:
+        return loader
+
+    def gen():
+        import numpy as np
+
+        it = iter(loader)
+        n = local_batch // batch_size
+        while True:
+            parts = [next(it) for _ in range(n)]
+            if isinstance(parts[0], tuple):
+                yield tuple(np.concatenate(f) for f in zip(*parts))
+            else:
+                yield np.concatenate(parts)
+
+    return gen()
+
+
+if __name__ == "__main__":
+    main(**parse_cli_args(sys.argv[1:]))

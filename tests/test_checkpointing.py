@@ -1,0 +1,186 @@
+"""Checkpointer tests: sharded save/restore roundtrip, resume preference,
+mesh resharding on load, single-file model loads, metadata, retention."""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fms_fsdp_tpu.config import TrainConfig
+from fms_fsdp_tpu.models.configs import LlamaConfig
+from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+from fms_fsdp_tpu.train.step import (
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+)
+from fms_fsdp_tpu.utils.checkpointing import Checkpointer
+
+TINY = LlamaConfig(
+    src_vocab_size=128,
+    emb_dim=32,
+    nheads=2,
+    kvheads=1,
+    nlayers=2,
+    multiple_of=8,
+    max_expected_seq_len=32,
+)
+
+
+def _cfg(**kw):
+    base = dict(
+        seq_length=16,
+        batch_size=2,
+        num_steps=50,
+        vocab_size=128,
+        attention_kernel="xla",
+        sharding_strategy="fsdp",
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _state(cfg, mesh, seed=0):
+    opt = make_optimizer(cfg)
+    state, _ = init_train_state(jax.random.PRNGKey(seed), TINY, cfg, mesh, opt)
+    return state, opt
+
+
+def _train_some(cfg, mesh, state, opt, n=3):
+    step = make_train_step(TINY, cfg, mesh, opt)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 128, size=(8, 17))
+    batch = (jnp.asarray(toks[:, :-1], jnp.int32), jnp.asarray(toks[:, 1:], jnp.int32))
+    for _ in range(n):
+        state, m = step(state, batch)
+    return state
+
+
+def test_save_load_roundtrip(tmp_path):
+    cfg = _cfg(ckpt_save_path=str(tmp_path))
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    state, opt = _state(cfg, mesh)
+    state = _train_some(cfg, mesh, state, opt)
+
+    ck = Checkpointer(str(tmp_path), 5, "fsdp", rank=0)
+    ck.save(3, state, None, tokens_seen=1234)
+    assert os.path.isdir(tmp_path / "checkpoints" / "step_3_ckp")
+
+    fresh, opt2 = _state(cfg, mesh, seed=99)  # different init
+    loaded, _, step, ntok, resuming = ck.load(fresh, None, path="")
+    assert resuming and step == 3 and ntok == 1234
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_load_prefers_save_dir(tmp_path):
+    """A checkpoint in the save dir (job restart) wins over the load path."""
+    cfg = _cfg(ckpt_save_path=str(tmp_path / "save"))
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    state, opt = _state(cfg, mesh)
+
+    other = Checkpointer(str(tmp_path / "other"), 5, "fsdp", rank=0)
+    other.save(7, state, None, tokens_seen=7)
+
+    ck = Checkpointer(str(tmp_path / "save"), 5, "fsdp", rank=0)
+    ck.save(2, state, None, tokens_seen=2)
+    _, _, step, ntok, resuming = ck.load(
+        state, None, path=str(tmp_path / "other" / "checkpoints")
+    )
+    assert resuming and step == 2 and ntok == 2
+
+
+def test_restore_across_mesh_shapes(tmp_path):
+    """Save under fsdp=8, restore into hsdp 2x4: optimizer resharding for
+    free via sharded-array IO."""
+    cfg1 = _cfg()
+    mesh1 = build_mesh(MeshConfig.from_train_config(cfg1))
+    state, opt = _state(cfg1, mesh1)
+    state = _train_some(cfg1, mesh1, state, opt, n=2)
+    ck = Checkpointer(str(tmp_path), 5, "fsdp", rank=0)
+    ck.save(2, state, None, tokens_seen=64)
+
+    cfg2 = _cfg(sharding_strategy="hsdp", sharding_group_size=4)
+    mesh2 = build_mesh(MeshConfig.from_train_config(cfg2))
+    fresh, opt2 = _state(cfg2, mesh2, seed=5)
+    loaded, _, step, ntok, _ = ck.load(fresh, None)
+    assert step == 2
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # and training continues under the new mesh
+    step_fn = make_train_step(TINY, cfg2, mesh2, opt2)
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, 128, size=(8, 17))
+    batch = (jnp.asarray(toks[:, :-1], jnp.int32), jnp.asarray(toks[:, 1:], jnp.int32))
+    _, m = step_fn(loaded, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_single_file_load(tmp_path):
+    """A pickle of bare model params loads params-only, step/opt reset."""
+    cfg = _cfg(ckpt_save_path=str(tmp_path))
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    state, opt = _state(cfg, mesh)
+    params_np = jax.tree.map(np.asarray, state["params"])
+    fpath = tmp_path / "model_only.pkl"
+    with open(fpath, "wb") as f:
+        pickle.dump({"model_state": params_np}, f)
+
+    ck = Checkpointer(str(tmp_path / "fresh"), 5, "ddp", rank=0)
+    fresh, _ = _state(cfg, mesh, seed=42)
+    loaded, _, step, ntok, resuming = ck.load(fresh, None, path=str(fpath))
+    assert step == 0 and ntok == 0 and not resuming
+    for a, b in zip(jax.tree.leaves(state["params"]), jax.tree.leaves(loaded["params"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_external_load_restarts_schedule(tmp_path):
+    """Loading an external checkpoint (not a job restart) keeps optimizer
+    moments but zeroes the step counter so the LR schedule restarts
+    (ref:main_training_llama.py:130-134 semantics)."""
+    cfg = _cfg()
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    state, opt = _state(cfg, mesh)
+    state = _train_some(cfg, mesh, state, opt, n=4)
+    assert int(state["step"]) == 4
+    old = Checkpointer(str(tmp_path / "old"), 5, "fsdp", rank=0)
+    old.save(4, state, None, tokens_seen=999)
+
+    # fresh save dir -> not resuming -> step restarts, moments retained
+    ck = Checkpointer(str(tmp_path / "new"), 5, "fsdp", rank=0)
+    fresh, _ = _state(cfg, mesh, seed=3)
+    loaded, _, step, ntok, resuming = ck.load(
+        fresh, None, path=str(tmp_path / "old" / "checkpoints")
+    )
+    assert not resuming and step == 0 and ntok == 0
+    assert int(loaded["step"]) == 0
+    mu_a = loaded["opt_state"].inner_state[0].mu["layers"]["wq"]
+    mu_b = state["opt_state"].inner_state[0].mu["layers"]["wq"]
+    np.testing.assert_array_equal(np.asarray(mu_a), np.asarray(mu_b))
+
+
+def test_no_checkpoint_starts_fresh(tmp_path):
+    cfg = _cfg(ckpt_save_path=str(tmp_path))
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    state, opt = _state(cfg, mesh)
+    ck = Checkpointer(str(tmp_path), 5, "fsdp", rank=0)
+    out, _, step, ntok, resuming = ck.load(state, None, path="/nonexistent")
+    assert step == 0 and ntok == 0 and not resuming
+
+
+def test_tmp_checkpoint_retention(tmp_path):
+    """Only 'tmp'-qualified checkpoints participate in rolling deletion."""
+    ck = Checkpointer(str(tmp_path), 2, "fsdp", rank=0)
+    for i in range(4):
+        d = tmp_path / "checkpoints" / f"step_{i}_tmp_ckp"
+        os.makedirs(d)
+        (d / "x").write_text("x")
+    keep = tmp_path / "checkpoints" / "step_9_ckp"
+    os.makedirs(keep)
+    ck._cleanup()
+    left = sorted(os.listdir(tmp_path / "checkpoints"))
+    assert "step_9_ckp" in left
+    assert len([x for x in left if "tmp" in x]) == 3  # oldest tmp removed

@@ -1,0 +1,51 @@
+"""End-to-end smoke test of the training entry point on the CPU mesh:
+dummy data, hsdp mesh, checkpoint save + resume (the reference's minimum
+slice, SURVEY.md §7 step 4)."""
+
+import os
+
+import pytest
+
+import main_training_llama
+
+
+TINY_OVERRIDES = {
+    "LlamaConfig.nlayers": 2,
+    "LlamaConfig.emb_dim": 64,
+    "LlamaConfig.nheads": 4,
+    "LlamaConfig.kvheads": 2,
+    "LlamaConfig.src_vocab_size": 256,
+    "LlamaConfig.multiple_of": 16,
+}
+
+
+def test_main_training_dummy_and_resume(tmp_path, capsys):
+    common = dict(
+        model_variant="llama2_7b",
+        use_dummy_dataset=True,
+        seq_length=32,
+        batch_size=2,
+        report_interval=5,
+        checkpoint_interval=10,
+        vocab_size=256,
+        sharding_strategy="hsdp",
+        sharding_group_size=4,
+        attention_kernel="xla",
+        ckpt_save_path=str(tmp_path),
+        ckpt_load_path=str(tmp_path),
+        **TINY_OVERRIDES,
+    )
+    main_training_llama.main(num_steps=12, **common)
+    out = capsys.readouterr().out
+    assert "step: 10" in out
+    assert os.path.isdir(tmp_path / "checkpoints" / "step_10_ckp")
+    assert os.path.isdir(tmp_path / "checkpoints" / "step_12_ckp")
+    losses = [float(l.split(":")[1]) for l in out.splitlines() if l.startswith("loss:")]
+    assert losses and losses[-1] < losses[0]
+
+    # resume continues from step 12
+    main_training_llama.main(num_steps=15, **common)
+    out = capsys.readouterr().out
+    assert "start_step = 12" in out
+    assert "step: 15" in out
+    assert os.path.isdir(tmp_path / "checkpoints" / "step_15_ckp")

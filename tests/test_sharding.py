@@ -87,7 +87,7 @@ def test_state_spec_inference():
     wq_spec = state["params"]["layers"]["wq"].sharding.spec
     assert wq_spec[1] == "fsdp"
     # adam mu mirrors the param sharding
-    mu = state["opt_state"][0].mu["layers"]["wq"]
+    mu = state["opt_state"].inner_state[0].mu["layers"]["wq"]
     assert mu.sharding.spec == state["params"]["layers"]["wq"].sharding.spec
     # scalar step replicated
     assert state["step"].sharding.spec == P()
