@@ -152,7 +152,11 @@ def llama_forward(
     scan_layers: bool = True,
     mesh: Optional[Mesh] = None,
 ):
-    """tokens (B, S) int32 -> logits (B, S, V) float32."""
+    """tokens (B, S) int32 -> logits (B, S, V) in the compute dtype.
+
+    Logits are NOT upcast here — at 128k vocab an fp32 copy would be the
+    largest buffer in the step; the CE loss upcasts inside its reductions.
+    """
     nlayers = params["layers"]["wq"].shape[0]
     # Cast the whole tree to compute dtype up front: with fp32 storage this
     # makes GSPMD's param all-gathers move bf16 bytes (the bfSixteen
@@ -187,5 +191,7 @@ def llama_forward(
 
     x = rms_norm(x, params["norm"], cfg.norm_eps)
     logits = x @ params["lm_head"]
-    logits = _constrain(logits, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
-    return logits.astype(jnp.float32)
+    # Logits stay in compute dtype: at 128k vocab an fp32 copy is the
+    # single largest buffer in the step. The loss upcasts inside its
+    # reductions (fp32 logsumexp) without materializing an fp32 tensor.
+    return _constrain(logits, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)

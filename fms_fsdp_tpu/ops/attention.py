@@ -46,24 +46,31 @@ def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None
     return out.reshape(b, sq, nq, h)
 
 
-try:  # the Pallas kernel only lowers on TPU backends
-    from fms_fsdp_tpu.ops.flash_attention import flash_attention as _flash
+try:  # Pallas/Mosaic may be absent on non-TPU jaxlib builds
+    from fms_fsdp_tpu.ops import flash_attention as _fa
 
     HAS_PALLAS_FLASH = True
 except ImportError:
-    _flash = None
+    _fa = None
     HAS_PALLAS_FLASH = False
 
 
 def attention(q, k, v, *, causal: bool = True, impl: str = "auto"):
-    """Dispatch to the Pallas flash kernel on TPU, XLA einsum elsewhere."""
+    """Dispatch: Pallas flash kernel on TPU for eligible shapes (head_dim a
+    128-multiple, 256-aligned seq), XLA einsum otherwise."""
     if impl == "pallas":
-        if not HAS_PALLAS_FLASH:
+        if not HAS_PALLAS_FLASH or not _fa.supports(q.shape, k.shape):
             raise NotImplementedError(
-                "attention_kernel='pallas' requested but the Pallas flash "
-                "attention kernel is unavailable in this build"
+                f"attention_kernel='pallas' requires Pallas support, a "
+                f"128-multiple head_dim and 256-aligned sequence lengths; "
+                f"got q{q.shape} k{k.shape}"
             )
-        return _flash(q, k, v, causal=causal)
-    if impl == "auto" and HAS_PALLAS_FLASH and jax.default_backend() == "tpu":
-        return _flash(q, k, v, causal=causal)
+        return _fa.flash_attention(q, k, v, causal=causal)
+    if (
+        impl == "auto"
+        and HAS_PALLAS_FLASH
+        and jax.default_backend() == "tpu"
+        and _fa.supports(q.shape, k.shape)
+    ):
+        return _fa.flash_attention(q, k, v, causal=causal)
     return xla_attention(q, k, v, causal=causal)

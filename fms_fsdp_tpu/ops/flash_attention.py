@@ -1,0 +1,392 @@
+"""Blockwise causal flash attention for TPU (Pallas/Mosaic).
+
+Replaces the reference's SDPA FlashAttention-2 CUDA path
+(ref:README.md:5,46) with MXU-tiled kernels:
+
+- forward: one grid step per (batch, q-head, q-block); the kv stream for
+  the matching GQA kv-head stays in VMEM and is walked block-by-block with
+  the FlashAttention-2 online softmax (fp32 running max/denominator), so
+  HBM traffic is O(S) and the (S, S) score matrix never materializes;
+- backward: a dq kernel mirroring the forward walk, and a dk/dv kernel
+  gridded per kv-block that re-walks q-blocks above the diagonal and
+  accumulates across the GQA group by output-block revisiting (TPU grids
+  execute sequentially, so revisited output blocks accumulate safely);
+- GQA native: kv heads are indexed via block-spec index maps
+  (kv_head = q_head // group) — kv is never materialized repeated
+  (70B trains at 64 q / 8 kv heads, ref:config_utils.py:26-34).
+
+The q/k/v layout inside the kernels is (B, N, S, H) with H = 128-multiple
+head dims (every reference variant has head_dim 128). Blockwise structure
+means a "context" mesh axis (ring attention) composes by walking remote kv
+blocks — see parallel/ring.py.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _causal_mask(scores, q_block, k_block, q_start, k_start):
+    qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (q_block, k_block), 0)
+    kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (q_block, k_block), 1)
+    return jnp.where(qpos >= kpos, scores, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k, causal):
+    block_q = q_ref.shape[2]
+    head = q_ref.shape[3]
+    seq_k = k_ref.shape[2]
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+
+    q = q_ref[0, 0]  # (BQ, H), native dtype feeds the MXU at full rate
+
+    if causal:
+        num_kb = (q_start + block_q + block_k - 1) // block_k
+    else:
+        num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k_start = kb * block_k
+        k = k_ref[0, 0, pl.ds(k_start, block_k), :]
+        v = v_ref[0, 0, pl.ds(k_start, block_k), :]
+        s = (
+            jax.lax.dot_general(
+                q,
+                k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (BQ, BK) fp32
+        if causal:
+            s = _causal_mask(s, block_q, block_k, q_start, k_start)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype),
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * alpha + pv
+        return acc, m_new, l
+
+    acc = jnp.zeros((block_q, head), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc, m, l))
+
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q: (B, Nq, Sq, H); k/v: (B, Nkv, Sk, H) -> (o, lse)."""
+    batch, nq, seq_q, head = q.shape
+    nkv, seq_k = k.shape[1], k.shape[2]
+    group = nq // nkv
+
+    grid = (batch, nq, seq_q // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_k=block_k, causal=causal
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, head), lambda b, h, i: (b, h, i, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, seq_k, head), lambda b, h, i: (b, h // group, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, seq_k, head), lambda b, h, i: (b, h // group, 0, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q, head), lambda b, h, i: (b, h, i, 0)
+            ),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, nq, seq_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward: dq
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_k, causal
+):
+    block_q = q_ref.shape[2]
+    head = q_ref.shape[3]
+    seq_k = k_ref.shape[2]
+    qi = pl.program_id(2)
+    q_start = qi * block_q
+
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0]  # (BQ, 1)
+    delta = delta_ref[0, 0]
+
+    if causal:
+        num_kb = (q_start + block_q + block_k - 1) // block_k
+    else:
+        num_kb = seq_k // block_k
+
+    def body(kb, dq):
+        k_start = kb * block_k
+        k = k_ref[0, 0, pl.ds(k_start, block_k), :]
+        v = v_ref[0, 0, pl.ds(k_start, block_k), :]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if causal:
+            s = _causal_mask(s, block_q, block_k, q_start, k_start)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = jnp.zeros((block_q, head), jnp.float32)
+    dq = jax.lax.fori_loop(0, num_kb, body, dq)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# backward: dk, dv
+# ---------------------------------------------------------------------------
+
+
+def _dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    *,
+    scale,
+    block_q,
+    causal,
+):
+    block_k = k_ref.shape[2]
+    head = k_ref.shape[3]
+    seq_q = q_ref.shape[2]
+    ki = pl.program_id(2)
+    g = pl.program_id(3)
+    k_start = ki * block_k
+
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+
+    qb_start = (k_start // block_q) if causal else 0
+    num_qb = seq_q // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_start = qb * block_q
+        q = q_ref[0, 0, pl.ds(q_start, block_q), :]
+        do = do_ref[0, 0, pl.ds(q_start, block_q), :]
+        lse = lse_ref[0, 0, pl.ds(q_start, block_q), :]
+        delta = delta_ref[0, 0, pl.ds(q_start, block_q), :]
+        s = (
+            jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )
+        if causal:
+            s = _causal_mask(s, block_q, block_k, q_start, k_start)
+        p = jnp.exp(s - lse)  # (BQ, BK) fp32
+        dv = dv + jax.lax.dot_general(
+            p.astype(do.dtype),
+            do,
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk = jnp.zeros((block_k, head), jnp.float32)
+    dv = jnp.zeros((block_k, head), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb_start, num_qb, body, (dk, dv))
+
+    # accumulate across the GQA group: grid's last dim (g) revisits the same
+    # output block sequentially
+    @pl.when(g == 0)
+    def _():
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(g > 0)
+    def _():
+        dk_ref[0, 0] += dk.astype(dk_ref.dtype)
+        dv_ref[0, 0] += dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout):
+    q, k, v, o, lse = residuals
+    batch, nq, seq_q, head = q.shape
+    nkv, seq_k = k.shape[1], k.shape[2]
+    group = nq // nkv
+
+    delta = jnp.sum(
+        o.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block_k=block_k, causal=causal),
+        grid=(batch, nq, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, seq_k, head), lambda b, h, i: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, seq_k, head), lambda b, h, i: (b, h // group, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, head), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, head), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block_q=block_q, causal=causal),
+        grid=(batch, nkv, seq_k // block_k, group),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, seq_q, head),
+                lambda b, kvh, i, g: (b, kvh * group + g, 0, 0),
+            ),
+            pl.BlockSpec((1, 1, block_k, head), lambda b, kvh, i, g: (b, kvh, i, 0)),
+            pl.BlockSpec((1, 1, block_k, head), lambda b, kvh, i, g: (b, kvh, i, 0)),
+            pl.BlockSpec(
+                (1, 1, seq_q, head),
+                lambda b, kvh, i, g: (b, kvh * group + g, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, seq_q, 1), lambda b, kvh, i, g: (b, kvh * group + g, 0, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, seq_q, 1), lambda b, kvh, i, g: (b, kvh * group + g, 0, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, head), lambda b, kvh, i, g: (b, kvh, i, 0)),
+            pl.BlockSpec((1, 1, block_k, head), lambda b, kvh, i, g: (b, kvh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bnsh(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o
+
+
+def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+_flash_attention_bnsh.defvjp(
+    _flash_attention_fwd,
+    lambda scale, causal, bq, bk, interp, res, g: _flash_bwd(
+        scale, causal, bq, bk, interp, res, g
+    ),
+)
+
+
+def _pick_block(seq: int, target: int) -> int:
+    b = min(seq, target)
+    while seq % b != 0:
+        b //= 2
+    return max(b, 1)
+
+
+def supports(q_shape, k_shape) -> bool:
+    """Eligibility of the Pallas path for these shapes."""
+    _, sq, nq, h = q_shape
+    _, sk, nkv, _ = k_shape
+    return (
+        h % 128 == 0
+        and sq % 256 == 0
+        and sk % 256 == 0
+        and nq % max(nkv, 1) == 0
+    )
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    scale=None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """q: (B, S, Nq, H); k/v: (B, S, Nkv, H) -> (B, S, Nq, H)."""
+    scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
+    block_q = _pick_block(q.shape[1], block_q)
+    block_k = _pick_block(k.shape[1], block_k)
+    # kernels run in (B, N, S, H)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    ot = _flash_attention_bnsh(
+        qt, kt, vt, scale, causal, block_q, block_k, interpret
+    )
+    return jnp.swapaxes(ot, 1, 2)

@@ -35,13 +35,23 @@ IGNORE_INDEX = -100  # torch CrossEntropyLoss default (ref:train_utils.py:90-91)
 
 
 def cross_entropy_loss(logits, labels):
-    """Token-mean CE over labels != -100, fp32, matching
-    ``CrossEntropyLoss()(output.view(-1, V), label.view(-1))``."""
-    logits = logits.astype(jnp.float32)
+    """Token-mean CE over labels != -100, matching
+    ``CrossEntropyLoss()(output.view(-1, V), label.view(-1))``.
+
+    Stable for bf16 logits: the max subtraction happens in the input dtype
+    (exact — it only drops the shared exponent) and the exp/sum accumulate
+    in fp32; no fp32 logits tensor is ever materialized.
+    """
     mask = labels != IGNORE_INDEX
     safe_labels = jnp.where(mask, labels, 0)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    shifted = (logits - m).astype(jnp.float32)
+    logz = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0].astype(
+        jnp.float32
+    )
+    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[
+        ..., 0
+    ].astype(jnp.float32)
     token_loss = (logz - gold) * mask
     return token_loss.sum() / jnp.maximum(mask.sum(), 1)
 
@@ -143,11 +153,12 @@ def make_train_step(
     metrics = {loss, gnorm (pre-clip global grad norm, the value the
     reference logs, ref:train_utils.py:96,109), lr}.
 
-    ``start_step`` must equal the value passed to ``make_optimizer``: nonzero
-    only when starting a fresh optimizer at a nonzero step (e.g. the
-    annealing stage over a loaded model, ref:main_training_llama.py:130-148).
-    When resuming a checkpointed opt_state, the schedule count resumes with
-    it — pass 0 to both.
+    The LR is evaluated at ``state["step"] + start_step`` and injected into
+    the optimizer each step; ``start_step`` is nonzero only when training
+    should behave as if already N steps in while state["step"] starts at 0
+    (the annealing-over-loaded-model flow, ref:main_training_llama.py:
+    137-148). Resumed checkpoints restore state["step"] itself, so they
+    pass 0.
     """
     policy = get_dtype_policy(cfg)
     ac_mask = None

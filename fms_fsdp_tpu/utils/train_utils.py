@@ -143,13 +143,6 @@ def train(
         // max(1, getattr(cfg, "context_parallel_size", 1))
     )
 
-    # device-resident metric window; fetched only at report time
-    window = []
-    train_loss = -1.0
-    start = time.time()
-    loop_start = time.time()
-    new_tokens_seen = 0
-
     try:
         train_loss = _train_loop(
             cfg,

@@ -32,8 +32,8 @@ def test_forward_shape_and_dtype(tiny_params):
     tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, TINY.src_vocab_size)
     logits = llama_forward(tiny_params, tokens, TINY, attn_impl="xla")
     assert logits.shape == (2, 16, TINY.src_vocab_size)
-    assert logits.dtype == jnp.float32
-    assert np.isfinite(np.asarray(logits)).all()
+    assert logits.dtype == jnp.bfloat16  # compute dtype; loss upcasts
+    assert np.isfinite(np.asarray(logits, dtype=np.float32)).all()
 
 
 def test_causality(tiny_params):
