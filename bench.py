@@ -5,8 +5,9 @@ Reference baseline (BASELINE.md): Llama2-7B at 4,550 tokens/sec/GPU and
 0.68 MFU on A100-80G (bs=2/GPU, seq 4096, bf16, compile on). A 7B *training*
 state (fp32 params + AdamW moments = 84GB) cannot exist on one 16GB chip,
 so the single-chip bench trains the largest reference variant that fits —
-llama3_194m_4k — at the reference's bs=2/seq=4096 settings and reports MFU,
-compared against the reference's best published MFU (0.68).
+llama3_194m_4k — at seq 4096 with the best single-chip config found
+(bs=4, selective AC 1/2; the metric label records it) and reports MFU
+against the reference's best published MFU (0.68).
 """
 
 import json

@@ -1,3 +1,30 @@
 from fms_fsdp_tpu.models.configs import LlamaConfig, MambaConfig
 
-__all__ = ["LlamaConfig", "MambaConfig"]
+__all__ = ["LlamaConfig", "MambaConfig", "get_model_api"]
+
+
+def get_model_api(model_cfg):
+    """Dispatch a model config to (init_fn, forward_fn, specs_fn, n_layers).
+
+    init_fn(key, cfg, dtype) -> params; forward_fn(params, tokens, cfg, ...)
+    -> logits; specs_fn() -> PartitionSpec tree mirroring params.
+    """
+    if isinstance(model_cfg, MambaConfig):
+        from fms_fsdp_tpu.models.mamba import (
+            init_mamba_params,
+            mamba_forward,
+            mamba_param_specs,
+        )
+
+        return (
+            init_mamba_params,
+            mamba_forward,
+            lambda: mamba_param_specs(model_cfg),
+            model_cfg.n_layer,
+        )
+    if isinstance(model_cfg, LlamaConfig):
+        from fms_fsdp_tpu.models.llama import init_llama_params, llama_forward
+        from fms_fsdp_tpu.parallel.sharding import llama_param_specs
+
+        return init_llama_params, llama_forward, llama_param_specs, model_cfg.nlayers
+    raise TypeError(f"unknown model config type: {type(model_cfg).__name__}")

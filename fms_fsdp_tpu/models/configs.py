@@ -114,3 +114,28 @@ class MambaConfig:
     @property
     def nheads(self) -> int:
         return self.d_inner // self.headdim
+
+    def n_params(self) -> int:
+        """Exact parameter count of the hybrid stack (see models/mamba.py)."""
+        d = self.d_model
+        conv_dim = self.d_inner + 2 * self.ngroups * self.d_state
+        in_proj = 2 * self.d_inner + 2 * self.ngroups * self.d_state + self.nheads
+        per_mamba = (
+            d * in_proj
+            + conv_dim * (self.d_conv + 1)  # conv weight + bias
+            + 3 * self.nheads  # dt_bias, A_log, D
+            + self.d_inner  # gated norm
+            + self.d_inner * d  # out_proj
+        )
+        a = self.attn_cfg
+        per_attn = d * a.head_dim * (a.num_heads * 2 + a.num_heads_kv * 2)
+        per_mlp = 3 * d * self.d_intermediate + d if self.d_intermediate else 0
+        n_attn = len(self.attn_layer_idx)
+        total = (
+            (self.n_layer - n_attn) * per_mamba
+            + n_attn * per_attn
+            + self.n_layer * (per_mlp + d)  # mlp (+norm2) and mixer norm
+            + d  # final norm
+            + 2 * self.padded_vocab_size * d
+        )
+        return int(total)

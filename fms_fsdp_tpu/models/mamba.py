@@ -1,0 +1,296 @@
+"""Mamba2 hybrid LM, TPU-native.
+
+Replaces the reference's external `mamba_ssm` dependency
+(ref:main_training_mamba.py:8-13, MambaConfig dict at
+ref:config_utils.py:162-185): a stack of pre-norm blocks where each block
+is  residual + mixer(norm(residual)), then residual + mlp(norm2(residual))
+(when d_intermediate > 0), with
+
+- mixer = Mamba2 on most layers: fused in_proj -> (z | xBC | dt), depthwise
+  causal conv1d with silu over xBC, softplus dt with learned bias,
+  negative-exponential A per head, chunked SSD selective scan (ops/ssd.py),
+  gated RMSNorm (norm(y * silu(z))), out_proj;
+- mixer = causal MHA on `attn_layer_idx` layers (9/18/27 for mamba_9.8b)
+  with GQA 32/8 heads, head_dim 128, partial rotary over the first 64 dims
+  (ref attn_cfg, config_utils.py:170-179);
+- swiglu MLP (d_intermediate) after every mixer;
+- fp32 residual stream (`residual_in_fp32`), RMSNorm everywhere, untied
+  embeddings with vocab padded to pad_vocab_size_multiple.
+
+Layers are heterogeneous, so the stack runs as an unrolled loop (not
+lax.scan); params live in a per-layer list pytree.
+"""
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fms_fsdp_tpu.models.configs import MambaConfig
+from fms_fsdp_tpu.ops.attention import attention
+from fms_fsdp_tpu.ops.norms import rms_norm
+from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
+from fms_fsdp_tpu.ops.ssd import causal_conv1d, ssd_scan
+from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_FSDP, AXIS_TENSOR, DATA_AXES
+
+Params = Dict[str, Any]
+
+
+def _conv_dim(cfg: MambaConfig) -> int:
+    return cfg.d_inner + 2 * cfg.ngroups * cfg.d_state
+
+
+def _in_proj_dim(cfg: MambaConfig) -> int:
+    return 2 * cfg.d_inner + 2 * cfg.ngroups * cfg.d_state + cfg.nheads
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+
+def init_mamba_params(key, cfg: MambaConfig, dtype=jnp.float32) -> Params:
+    d = cfg.d_model
+    v = cfg.padded_vocab_size
+    H = cfg.nheads
+    std = 0.02
+    out_std = std / (2 * cfg.n_layer) ** 0.5
+
+    def tn(k, shape, s):
+        return (jax.random.truncated_normal(k, -3, 3, shape, jnp.float32) * s).astype(
+            dtype
+        )
+
+    keys = iter(jax.random.split(key, 8 * cfg.n_layer + 4))
+
+    def mamba_mixer():
+        # dt bias: softplus^-1 of dt ~ LogUniform[1e-3, 1e-1] (mamba2 init)
+        u = jax.random.uniform(next(keys), (H,), jnp.float32)
+        dt = jnp.exp(u * (jnp.log(0.1) - jnp.log(1e-3)) + jnp.log(1e-3))
+        dt = jnp.clip(dt, 1e-4)
+        dt_bias = dt + jnp.log(-jnp.expm1(-dt))
+        # A ~ Uniform[1, 16]
+        A = jax.random.uniform(next(keys), (H,), jnp.float32, 1.0, 16.0)
+        return {
+            "in_proj": tn(next(keys), (d, _in_proj_dim(cfg)), std),
+            "conv_w": tn(next(keys), (_conv_dim(cfg), cfg.d_conv), std * 10),
+            "conv_b": jnp.zeros((_conv_dim(cfg),), dtype),
+            "dt_bias": dt_bias.astype(dtype),
+            "A_log": jnp.log(A).astype(dtype),
+            "D": jnp.ones((H,), dtype),
+            "norm": jnp.ones((cfg.d_inner,), dtype),
+            "out_proj": tn(next(keys), (cfg.d_inner, d), out_std),
+        }
+
+    def attn_mixer():
+        a = cfg.attn_cfg
+        hd = a.head_dim
+        return {
+            "wq": tn(next(keys), (d, a.num_heads * hd), std),
+            "wk": tn(next(keys), (d, a.num_heads_kv * hd), std),
+            "wv": tn(next(keys), (d, a.num_heads_kv * hd), std),
+            "wo": tn(next(keys), (a.num_heads * hd, d), out_std),
+        }
+
+    layers: List[Params] = []
+    for i in range(cfg.n_layer):
+        layer = {
+            "norm": jnp.ones((d,), dtype),
+            "mixer": attn_mixer() if i in cfg.attn_layer_idx else mamba_mixer(),
+        }
+        if cfg.d_intermediate > 0:
+            layer["norm2"] = jnp.ones((d,), dtype)
+            layer["mlp"] = {
+                "w1": tn(next(keys), (d, cfg.d_intermediate), std),
+                "w3": tn(next(keys), (d, cfg.d_intermediate), std),
+                "w2": tn(next(keys), (cfg.d_intermediate, d), out_std),
+            }
+        layers.append(layer)
+
+    return {
+        "embedding": tn(next(keys), (v, d), std),
+        "layers": layers,
+        "norm_f": jnp.ones((d,), dtype),
+        "lm_head": tn(next(keys), (d, v), std),
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _constrain(x, spec, mesh):
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
+
+
+def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh):
+    """x (B, S, D) compute dtype -> (B, S, D)."""
+    B, S, d = x.shape
+    H, Pd, G, N = cfg.nheads, cfg.headdim, cfg.ngroups, cfg.d_state
+    d_inner = cfg.d_inner
+
+    zxbcdt = x @ p["in_proj"]
+    zxbcdt = _constrain(zxbcdt, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
+    z = zxbcdt[..., :d_inner]
+    xBC = zxbcdt[..., d_inner : d_inner + _conv_dim(cfg)]
+    dt_raw = zxbcdt[..., d_inner + _conv_dim(cfg) :]  # (B, S, H)
+
+    xBC = causal_conv1d(xBC, p["conv_w"], p["conv_b"], activation="silu")
+    xs = xBC[..., :d_inner].reshape(B, S, H, Pd)
+    Bm = xBC[..., d_inner : d_inner + G * N].reshape(B, S, G, N)
+    Cm = xBC[..., d_inner + G * N :].reshape(B, S, G, N)
+
+    dt = jax.nn.softplus(
+        dt_raw.astype(jnp.float32) + p["dt_bias"].astype(jnp.float32)
+    )
+    A = -jnp.exp(p["A_log"].astype(jnp.float32))
+
+    y = ssd_scan(xs, dt, A, Bm, Cm, p["D"], chunk_size=cfg.chunk_size)
+    y = y.reshape(B, S, d_inner)
+
+    # gated RMSNorm: norm(y * silu(z)) (mamba2 norm_before_gate=False)
+    y = rms_norm(y * jax.nn.silu(z), p["norm"], cfg.norm_eps)
+    out = y @ p["out_proj"]
+    return _constrain(out, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+
+
+def _attn_mixer(x, p: Params, cfg: MambaConfig, cos, sin, attn_impl, mesh):
+    B, S, d = x.shape
+    a = cfg.attn_cfg
+    hd = a.head_dim
+    q = (x @ p["wq"]).reshape(B, S, a.num_heads, hd)
+    k = (x @ p["wk"]).reshape(B, S, a.num_heads_kv, hd)
+    v = (x @ p["wv"]).reshape(B, S, a.num_heads_kv, hd)
+
+    # partial rotary: first rotary_emb_dim dims of each head
+    r = a.rotary_emb_dim
+    if r and r < hd:
+        q = jnp.concatenate(
+            [apply_rotary(q[..., :r], cos, sin), q[..., r:]], axis=-1
+        )
+        k = jnp.concatenate(
+            [apply_rotary(k[..., :r], cos, sin), k[..., r:]], axis=-1
+        )
+    elif r:
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+
+    o = attention(q, k, v, causal=a.causal, impl=attn_impl)
+    o = o.reshape(B, S, a.num_heads * hd) @ p["wo"]
+    return _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+
+
+def _mlp(x, p: Params, mesh):
+    gate = jax.nn.silu(x @ p["w1"])
+    up = x @ p["w3"]
+    h = _constrain(gate * up, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
+    return _constrain(h @ p["w2"], P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+
+
+def mamba_forward(
+    params: Params,
+    tokens,
+    cfg: MambaConfig,
+    *,
+    compute_dtype=jnp.bfloat16,
+    attn_impl: str = "auto",
+    ac_mask: Optional[List[bool]] = None,
+    scan_layers: bool = False,  # heterogeneous layers: always unrolled
+    mesh: Optional[Mesh] = None,
+):
+    """tokens (B, S) int32 -> logits (B, S, padded_vocab) in compute dtype."""
+    del scan_layers
+    params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    n_layer = len(params["layers"])
+    ac_mask = ac_mask if ac_mask is not None else [False] * n_layer
+
+    x = params["embedding"][tokens]
+    x = _constrain(x, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    residual = x.astype(jnp.float32)  # residual_in_fp32
+
+    seq_len = tokens.shape[1]
+    a = cfg.attn_cfg
+    cos, sin = rope_table(seq_len, a.rotary_emb_dim or a.head_dim, 10000.0)
+
+    def block(residual, layer, is_attn):
+        h = rms_norm(residual.astype(compute_dtype), layer["norm"], cfg.norm_eps)
+        if is_attn:
+            out = _attn_mixer(h, layer["mixer"], cfg, cos, sin, attn_impl, mesh)
+        else:
+            out = _mamba_mixer(h, layer["mixer"], cfg, mesh)
+        residual = residual + out.astype(jnp.float32)
+        if "mlp" in layer:
+            h = rms_norm(
+                residual.astype(compute_dtype), layer["norm2"], cfg.norm_eps
+            )
+            residual = residual + _mlp(h, layer["mlp"], mesh).astype(jnp.float32)
+        return residual
+
+    for i, layer in enumerate(params["layers"]):
+        fn = functools.partial(block, is_attn=i in cfg.attn_layer_idx)
+        if ac_mask[i]:
+            fn = jax.checkpoint(fn, prevent_cse=False)
+        residual = fn(residual, layer)
+
+    x = rms_norm(residual.astype(compute_dtype), params["norm_f"], cfg.norm_eps)
+    logits = x @ params["lm_head"]
+    return _constrain(logits, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
+
+
+# ---------------------------------------------------------------------------
+# sharding rulebook
+# ---------------------------------------------------------------------------
+
+
+def mamba_param_specs(cfg: MambaConfig) -> Params:
+    """PartitionSpec tree matching init_mamba_params' structure."""
+
+    def mamba_mixer():
+        return {
+            "in_proj": P(AXIS_FSDP, AXIS_TENSOR),
+            "conv_w": P(AXIS_FSDP, None),
+            "conv_b": P(AXIS_FSDP),
+            "dt_bias": P(None),
+            "A_log": P(None),
+            "D": P(None),
+            "norm": P(None),
+            "out_proj": P(AXIS_TENSOR, AXIS_FSDP),
+        }
+
+    def attn_mixer():
+        return {
+            "wq": P(AXIS_FSDP, AXIS_TENSOR),
+            "wk": P(AXIS_FSDP, AXIS_TENSOR),
+            "wv": P(AXIS_FSDP, AXIS_TENSOR),
+            "wo": P(AXIS_TENSOR, AXIS_FSDP),
+        }
+
+    layers = []
+    for i in range(cfg.n_layer):
+        layer = {
+            "norm": P(None),
+            "mixer": attn_mixer() if i in cfg.attn_layer_idx else mamba_mixer(),
+        }
+        if cfg.d_intermediate > 0:
+            layer["norm2"] = P(None)
+            layer["mlp"] = {
+                "w1": P(AXIS_FSDP, AXIS_TENSOR),
+                "w3": P(AXIS_FSDP, AXIS_TENSOR),
+                "w2": P(AXIS_TENSOR, AXIS_FSDP),
+            }
+        layers.append(layer)
+
+    return {
+        "embedding": P(AXIS_TENSOR, AXIS_FSDP),
+        "layers": layers,
+        "norm_f": P(None),
+        "lm_head": P(AXIS_FSDP, AXIS_TENSOR),
+    }
+
+

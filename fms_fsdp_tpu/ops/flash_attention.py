@@ -254,13 +254,13 @@ def _dkv_kernel(
     # output block sequentially
     @pl.when(g == 0)
     def _():
-        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+        dk_ref[0, 0] = dk
+        dv_ref[0, 0] = dv
 
     @pl.when(g > 0)
     def _():
-        dk_ref[0, 0] += dk.astype(dk_ref.dtype)
-        dv_ref[0, 0] += dv.astype(dv_ref.dtype)
+        dk_ref[0, 0] += dk
+        dv_ref[0, 0] += dv
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout):
@@ -314,14 +314,16 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout):
             pl.BlockSpec((1, 1, block_k, head), lambda b, kvh, i, g: (b, kvh, i, 0)),
             pl.BlockSpec((1, 1, block_k, head), lambda b, kvh, i, g: (b, kvh, i, 0)),
         ],
+        # fp32 outputs: the cross-group revisit accumulation must not round
+        # to bf16 between group members (llama2_70b accumulates 8 of them)
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(k.shape, jnp.float32),
+            jax.ShapeDtypeStruct(v.shape, jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, dout, lse, delta)
 
-    return dq, dk, dv
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -355,6 +357,13 @@ def _pick_block(seq: int, target: int) -> int:
     return max(b, 1)
 
 
+# The kernels stage the full per-head sequence in VMEM (k+v forward; q+do
+# additionally in the dk/dv pass): ~8 * S * H bytes. Cap the sequence so
+# residency stays within the ~16MB/core budget; longer contexts use the
+# ring/context-parallel path or the XLA fallback.
+MAX_KERNEL_SEQ = 8192
+
+
 def supports(q_shape, k_shape) -> bool:
     """Eligibility of the Pallas path for these shapes."""
     _, sq, nq, h = q_shape
@@ -363,6 +372,8 @@ def supports(q_shape, k_shape) -> bool:
         h % 128 == 0
         and sq % 256 == 0
         and sk % 256 == 0
+        and sq <= MAX_KERNEL_SEQ
+        and sk <= MAX_KERNEL_SEQ
         and nq % max(nkv, 1) == 0
     )
 

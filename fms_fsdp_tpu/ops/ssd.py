@@ -1,0 +1,164 @@
+"""Mamba2 selective scan — chunked SSD (state-space dual) formulation.
+
+Replaces the mamba_ssm CUDA/Triton selective-scan kernels the reference
+depends on (ref:main_training_mamba.py:8-13, config ssm_cfg layer=Mamba2
+at ref:config_utils.py:162-185) with a TPU-native implementation.
+
+The SSD algorithm re-expresses the per-token recurrence
+
+    h_t = exp(dt_t * A) * h_{t-1} + dt_t * B_t x_t^T        (state (H, P, N))
+    y_t = C_t . h_t + D * x_t
+
+as chunked matmuls: inside a chunk the output is a masked (L, L)
+attention-like product, and only one (P, N) state per head crosses chunk
+boundaries via a short `lax.scan`. This keeps ~all FLOPs in MXU-shaped
+einsums (the reason SSD exists) — XLA maps it well without a custom
+kernel; inter-chunk recurrence is carried in fp32
+(`residual_in_fp32`-style numerics, ref:config_utils.py:181-183).
+
+Shapes: x (B, S, H, P), dt (B, S, H) (post-softplus), A (H,) negative,
+Bm/Cm (B, S, G, N) with H % G == 0.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _segsum(a):
+    """a: (..., L) -> (..., L, L) with out[i, j] = sum(a[j+1 .. i]),
+    -inf above the diagonal (i < j)."""
+    L = a.shape[-1]
+    cum = jnp.cumsum(a, axis=-1)
+    diff = cum[..., :, None] - cum[..., None, :]  # sum(a[j+1..i]) for i>=j
+    mask = jax.lax.broadcasted_iota(jnp.int32, (L, L), 0) >= jax.lax.broadcasted_iota(
+        jnp.int32, (L, L), 1
+    )
+    return jnp.where(mask, diff, -jnp.inf)
+
+
+def ssd_scan(x, dt, A, Bm, Cm, D=None, chunk_size: int = 256):
+    """Chunked selective scan. Returns y with x's shape, computed in fp32,
+    cast back to x.dtype."""
+    Bsz, S, H, P = x.shape
+    G, N = Bm.shape[2], Bm.shape[3]
+    L = min(chunk_size, S)
+    assert S % L == 0, f"seq len {S} must be a multiple of chunk {L}"
+    C = S // L
+    rep = H // G
+
+    xf = x.astype(jnp.float32)
+    dtf = dt.astype(jnp.float32)
+    Bf = Bm.astype(jnp.float32)
+    Cf = Cm.astype(jnp.float32)
+    a = dtf * A.astype(jnp.float32)[None, None, :]  # (B, S, H), <= 0
+
+    # chunked views
+    xc = xf.reshape(Bsz, C, L, H, P)
+    dtc = dtf.reshape(Bsz, C, L, H)
+    ac = a.reshape(Bsz, C, L, H)
+    Bc = Bf.reshape(Bsz, C, L, G, N)
+    Cc = Cf.reshape(Bsz, C, L, G, N)
+
+    # ---- intra-chunk (masked attention-like) term --------------------------
+    # seg[b,c,h,i,j] = sum(a[j+1..i]); CB[b,c,i,j,g] = C_i . B_j
+    seg = _segsum(jnp.moveaxis(ac, -1, 2))  # (B, C, H, L, L)
+    decay = jnp.exp(seg)  # masked: 0 above diagonal
+    CB = jnp.einsum("bclgn,bcmgn->bclmg", Cc, Bc)  # (B, C, L, L, G)
+    CB = jnp.repeat(CB, rep, axis=-1)  # (B, C, L, L, H)
+    w = CB * jnp.moveaxis(decay, 2, -1) * dtc[:, :, None, :, :]  # i,j,h
+    y = jnp.einsum("bcijh,bcjhp->bcihp", w, xc)
+
+    # ---- chunk states ------------------------------------------------------
+    # state contribution of chunk c: sum_j exp(sum(a[j+1..L-1])) dt_j B_j x_j^T
+    cum = jnp.cumsum(ac, axis=2)  # (B, C, L, H)
+    total = cum[:, :, -1:, :]  # (B, C, 1, H)
+    r = jnp.exp(total - cum)  # decay from j to chunk end
+    Bh = jnp.repeat(Bc, rep, axis=3)  # (B, C, L, H, N)
+    states = jnp.einsum(
+        "bclh,bclhn,bclhp->bchpn", r * dtc, Bh, xc
+    )  # (B, C, H, P, N)
+
+    # ---- inter-chunk recurrence (fp32 carried state) -----------------------
+    chunk_decay = jnp.exp(total[:, :, 0, :])  # (B, C, H)
+
+    def scan_fn(s_prev, inp):
+        dec, st = inp  # dec (B, H), st (B, H, P, N)
+        s_new = s_prev * dec[:, :, None, None] + st
+        return s_new, s_prev
+
+    init = jnp.zeros((Bsz, H, P, N), jnp.float32)
+    _, s_before = lax.scan(
+        scan_fn,
+        init,
+        (jnp.moveaxis(chunk_decay, 1, 0), jnp.moveaxis(states, 1, 0)),
+    )
+    s_before = jnp.moveaxis(s_before, 0, 1)  # (B, C, H, P, N): state entering chunk
+
+    # ---- inter-chunk output term ------------------------------------------
+    Ch = jnp.repeat(Cc, rep, axis=3)  # (B, C, L, H, N)
+    y = y + jnp.einsum(
+        "bclh,bclhn,bchpn->bclhp", jnp.exp(cum), Ch, s_before
+    )
+
+    if D is not None:
+        y = y + D.astype(jnp.float32)[None, None, :, None] * xc
+
+    return y.reshape(Bsz, S, H, P).astype(x.dtype)
+
+
+def ssd_scan_reference(x, dt, A, Bm, Cm, D=None):
+    """Sequential per-token recurrence (ground truth for tests)."""
+    Bsz, S, H, P = x.shape
+    G, N = Bm.shape[2], Bm.shape[3]
+    rep = H // G
+    xf = x.astype(jnp.float32)
+    dtf = dt.astype(jnp.float32)
+    Bf = jnp.repeat(Bm.astype(jnp.float32), rep, axis=2)
+    Cf = jnp.repeat(Cm.astype(jnp.float32), rep, axis=2)
+    Af = A.astype(jnp.float32)
+
+    def step(h, inp):
+        xt, dtt, Bt, Ct = inp  # (B,H,P), (B,H), (B,H,N), (B,H,N)
+        h = h * jnp.exp(dtt * Af)[:, :, None, None] + jnp.einsum(
+            "bh,bhn,bhp->bhpn", dtt, Bt, xt
+        )
+        y = jnp.einsum("bhn,bhpn->bhp", Ct, h)
+        return h, y
+
+    init = jnp.zeros((Bsz, H, P, N), jnp.float32)
+    _, ys = lax.scan(
+        step,
+        init,
+        (
+            jnp.moveaxis(xf, 1, 0),
+            jnp.moveaxis(dtf, 1, 0),
+            jnp.moveaxis(Bf, 1, 0),
+            jnp.moveaxis(Cf, 1, 0),
+        ),
+    )
+    y = jnp.moveaxis(ys, 0, 1)
+    if D is not None:
+        y = y + D.astype(jnp.float32)[None, None, :, None] * xf
+    return y.astype(x.dtype)
+
+
+def causal_conv1d(x, weight, bias=None, activation: str = "silu"):
+    """Depthwise causal conv over (B, S, C) with kernel (C, W), the
+    mamba_ssm causal_conv1d equivalent."""
+    B, S, Cch = x.shape
+    W = weight.shape[-1]
+    xt = jnp.pad(x, ((0, 0), (W - 1, 0), (0, 0)))
+    out = lax.conv_general_dilated(
+        xt.astype(jnp.float32),
+        weight.astype(jnp.float32)[:, None, :].transpose(2, 1, 0),  # (W, 1, C)
+        window_strides=(1,),
+        padding="VALID",
+        dimension_numbers=("NWC", "WIO", "NWC"),
+        feature_group_count=Cch,
+    )
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)[None, None, :]
+    if activation == "silu":
+        out = jax.nn.silu(out)
+    return out.astype(x.dtype)

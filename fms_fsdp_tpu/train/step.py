@@ -19,14 +19,12 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from fms_fsdp_tpu.models.configs import LlamaConfig
-from fms_fsdp_tpu.models.llama import init_llama_params, llama_forward
+from fms_fsdp_tpu.models import get_model_api
 from fms_fsdp_tpu.parallel.ac import selective_ac_mask
 from fms_fsdp_tpu.parallel.mixed_precision import get_dtype_policy
 from fms_fsdp_tpu.parallel.sharding import (
     batch_pspec,
     infer_state_specs,
-    llama_param_specs,
     resolve_spec,
     tree_shardings,
 )
@@ -109,12 +107,13 @@ def make_optimizer(cfg, start_step: int = 0):
 
 def init_train_state(
     rng,
-    model_cfg: LlamaConfig,
+    model_cfg,
     cfg,
     mesh,
     optimizer,
 ):
-    """Create the fully sharded train state {params, opt_state, step}.
+    """Create the fully sharded train state {params, opt_state, step} for
+    any supported model family (Llama, Mamba hybrid).
 
     Init runs *inside jit with sharded outputs*: each device materializes
     only its own param/opt shards — the TPU analog of the reference's
@@ -124,9 +123,10 @@ def init_train_state(
     do it.
     """
     policy = get_dtype_policy(cfg)
+    init_params, _, specs_fn, _ = get_model_api(model_cfg)
 
     def init_fn(rng):
-        params = init_llama_params(rng, model_cfg, dtype=policy.param_dtype)
+        params = init_params(rng, model_cfg, dtype=policy.param_dtype)
         return {
             "params": params,
             "opt_state": optimizer.init(params),
@@ -134,7 +134,7 @@ def init_train_state(
         }
 
     shapes = jax.eval_shape(init_fn, rng)
-    specs = infer_state_specs(shapes, llama_param_specs())
+    specs = infer_state_specs(shapes, specs_fn())
     shardings = tree_shardings(
         mesh, specs, jax.tree.map(lambda s: s.shape, shapes)
     )
@@ -142,7 +142,7 @@ def init_train_state(
 
 
 def make_train_step(
-    model_cfg: LlamaConfig,
+    model_cfg,
     cfg,
     mesh,
     optimizer,
@@ -161,13 +161,14 @@ def make_train_step(
     pass 0.
     """
     policy = get_dtype_policy(cfg)
+    _, forward_fn, _, n_layers = get_model_api(model_cfg)
     ac_mask = None
     if cfg.fsdp_activation_checkpointing:
-        ac_mask = selective_ac_mask(model_cfg.nlayers, cfg.selective_checkpointing)
+        ac_mask = selective_ac_mask(n_layers, cfg.selective_checkpointing)
     schedule = get_lr_schedule(cfg, start_step)
 
     def loss_fn(params, inputs, labels):
-        logits = llama_forward(
+        logits = forward_fn(
             params,
             inputs,
             model_cfg,

@@ -1,0 +1,27 @@
+"""Mamba hybrid pretraining entry point (ref:main_training_mamba.py:28-171).
+
+The reference's mamba entry is the llama entry with the model swapped
+(mamba_ssm MambaLMHeadModel + Block, per-rank Triton cache dirs); here the
+whole orchestration is shared — ``get_model_config("mamba_9.8b")`` returns
+a MambaConfig and the train-step factory dispatches to the Mamba2 hybrid
+forward (models/mamba.py). No kernel cache management is needed: XLA/Mosaic
+compile caching is process-global.
+
+Run:  python main_training_mamba.py --use_dummy_dataset=True --num_steps=100
+"""
+
+import sys
+
+from fms_fsdp_tpu.utils.cli import parse_cli_args
+
+from main_training_llama import main as _shared_main
+
+
+def main(**kwargs):
+    kwargs.setdefault("model_variant", "mamba_9.8b")
+    kwargs.setdefault("vocab_size", 128256)
+    return _shared_main(**kwargs)
+
+
+if __name__ == "__main__":
+    main(**parse_cli_args(sys.argv[1:]))
