@@ -219,6 +219,26 @@ def get_data_loader(cfg, rank, world_size, postprocess=None):
     )
 
 
+def rebatch(loader, local_batch: int, batch_size: int):
+    """Concatenate per-rank batches (of ``batch_size`` rows) into
+    process-local device batches of ``local_batch`` rows — the bridge from
+    the reference's per-GPU batch_size to a per-process multi-chip batch."""
+    if local_batch == batch_size:
+        return loader
+
+    def gen():
+        it = iter(loader)
+        n = local_batch // batch_size
+        while True:
+            parts = [next(it) for _ in range(n)]
+            if isinstance(parts[0], tuple):
+                yield tuple(np.concatenate(f) for f in zip(*parts))
+            else:
+                yield np.concatenate(parts)
+
+    return gen()
+
+
 def parse_data_args(datas, weights):
     """csv strings -> lists (ref:dataloader_utils.py:149-163)."""
 

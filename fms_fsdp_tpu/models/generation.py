@@ -1,0 +1,183 @@
+"""Autoregressive generation with a kv-cache for the Llama family.
+
+Replaces the reference's embeds-returning ``generate`` copy
+(ref:speculator/train_speculator_utils.py:28-118): prefill + a
+``lax.scan`` decode loop entirely under jit — no Python in the token loop
+(SURVEY.md §7 hard part 4). Supports temperature / top-k sampling or
+greedy decode, and optionally returns the final hidden state (embedding)
+of every generated position for speculator stage-2 training.
+
+The kv cache is a pytree {"k", "v"} of (L, B, S_max, Nkv, H) arrays
+carried through the scan; each decode step runs the layer stack as an
+inner ``lax.scan`` whose xs are the stacked layer params + cache slices.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from fms_fsdp_tpu.models.configs import LlamaConfig
+from fms_fsdp_tpu.models.llama import llama_forward
+from fms_fsdp_tpu.ops.norms import rms_norm
+from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
+
+
+def _decode_attention(q, k_cache, v_cache, cur_pos):
+    """q (B, 1, Nq, H) against cache (B, S, Nkv, H); positions > cur_pos
+    masked out. Returns (B, 1, Nq, H)."""
+    b, _, nq, h = q.shape
+    s, nkv = k_cache.shape[1], k_cache.shape[2]
+    group = nq // nkv
+    qg = q.reshape(b, nkv, group, h)  # squeeze the singleton seq dim
+    scores = jnp.einsum(
+        "bkgh,bskh->bkgs", qg, k_cache, preferred_element_type=jnp.float32
+    ) * (h**-0.5)
+    idx = jnp.arange(s)[None, None, None, :]
+    scores = jnp.where(idx <= cur_pos, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgs,bskh->bkgh", probs, v_cache)
+    return out.reshape(b, 1, nq, h)
+
+
+def prefill(params, tokens, cfg: LlamaConfig, max_seq_len: int, compute_dtype=jnp.bfloat16):
+    """Run the prompt through the model, building the kv cache.
+
+    Returns (logits (B, S, V), embeds (B, S, D), cache). The cache holds
+    max_seq_len positions; positions >= len(prompt) are zeros until decode
+    writes them.
+    """
+    params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    b, s = tokens.shape
+    hd, nkv = cfg.head_dim, cfg.n_kv_heads
+    nlayers = params["layers"]["wq"].shape[0]
+
+    cos, sin = rope_table(max_seq_len, hd, cfg.rope_theta)
+    x = params["embedding"][tokens]
+
+    def body(x, layer):
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (h @ layer["wq"]).reshape(b, s, cfg.nheads, hd)
+        k = (h @ layer["wk"]).reshape(b, s, nkv, hd)
+        v = (h @ layer["wv"]).reshape(b, s, nkv, hd)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+        from fms_fsdp_tpu.ops.attention import attention
+
+        o = attention(q, k, v, causal=True, impl="xla")
+        x = x + o.reshape(b, s, cfg.nheads * hd) @ layer["wo"]
+        h2 = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+        ffn = (jax.nn.silu(h2 @ layer["w1"]) * (h2 @ layer["w3"])) @ layer["w2"]
+        # cache entries padded out to max_seq_len
+        pad = [(0, 0), (0, max_seq_len - s), (0, 0), (0, 0)]
+        return x + ffn, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, (k_cache, v_cache) = lax.scan(body, x, params["layers"])
+    embeds = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = embeds @ params["lm_head"]
+    return logits, embeds, {"k": k_cache, "v": v_cache}
+
+
+def decode_step(params, cache, token, pos, cfg: LlamaConfig, compute_dtype=jnp.bfloat16):
+    """One cached decode step. token (B, 1) int32 at position ``pos``.
+
+    Returns (logits (B, V), embeds (B, D), updated cache)."""
+    params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    b = token.shape[0]
+    hd, nkv = cfg.head_dim, cfg.n_kv_heads
+    max_seq = cache["k"].shape[2]
+
+    cos, sin = rope_table(max_seq, hd, cfg.rope_theta)
+    positions = jnp.full((b, 1), pos, jnp.int32)
+    x = params["embedding"][token]  # (B, 1, D)
+
+    def body(x, inp):
+        layer, k_cache, v_cache = inp
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = (h @ layer["wq"]).reshape(b, 1, cfg.nheads, hd)
+        k = (h @ layer["wk"]).reshape(b, 1, nkv, hd)
+        v = (h @ layer["wv"]).reshape(b, 1, nkv, hd)
+        q = apply_rotary(q, cos, sin, positions)
+        k = apply_rotary(k, cos, sin, positions)
+        k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        o = _decode_attention(q, k_cache, v_cache, pos)
+        x = x + o.reshape(b, 1, cfg.nheads * hd) @ layer["wo"]
+        h2 = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+        ffn = (jax.nn.silu(h2 @ layer["w1"]) * (h2 @ layer["w3"])) @ layer["w2"]
+        return x + ffn, (k_cache, v_cache)
+
+    x, (k_cache, v_cache) = lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    embeds = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = embeds @ params["lm_head"]
+    return logits[:, 0], embeds[:, 0], {"k": k_cache, "v": v_cache}
+
+
+def _sample(logits, key, temperature, top_k, do_sample):
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "max_seq_len",
+        "max_new_tokens",
+        "temperature",
+        "top_k",
+        "do_sample",
+        "include_embeds",
+    ),
+)
+def generate(
+    params,
+    input_ids,
+    cfg: LlamaConfig,
+    *,
+    key,
+    max_seq_len: int = 2048,
+    max_new_tokens: int = 256,
+    temperature: float = 1.0,
+    top_k: int = 10,
+    do_sample: bool = True,
+    include_embeds: bool = True,
+):
+    """Autoregressive generation (ref:train_speculator_utils.py:28-118).
+
+    input_ids (B, P) -> result (B, P + max_new_tokens); with
+    ``include_embeds`` also returns embeds (B, max_new_tokens, D): the
+    final hidden state at each *generated* position (the state that
+    predicted the NEXT token), matching the reference's embeds capture.
+    """
+    b, prompt_len = input_ids.shape
+    logits, prefill_embeds, cache = prefill(params, input_ids, cfg, max_seq_len)
+    last_logits = logits[:, -1]
+    last_embed = prefill_embeds[:, -1]
+
+    def step(carry, key_t):
+        cache, last_logits, last_embed, pos = carry
+        tok = _sample(last_logits, key_t, temperature, top_k, do_sample)
+        logits, embeds, cache = decode_step(
+            params, cache, tok[:, None], pos, cfg
+        )
+        return (cache, logits, embeds, pos + 1), (tok, last_embed)
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _, _, _), (tokens, embeds) = lax.scan(
+        step, (cache, last_logits, last_embed, prompt_len), keys
+    )
+    tokens = jnp.moveaxis(tokens, 0, 1)  # (B, T)
+    result = jnp.concatenate([input_ids, tokens], axis=1)
+    if include_embeds:
+        return result, jnp.moveaxis(embeds, 0, 1)  # (B, T, D)
+    return result

@@ -151,6 +151,7 @@ def llama_forward(
     ac_mask: Optional[List[bool]] = None,
     scan_layers: bool = True,
     mesh: Optional[Mesh] = None,
+    return_embeds: bool = False,
 ):
     """tokens (B, S) int32 -> logits (B, S, V) in the compute dtype.
 
@@ -194,4 +195,10 @@ def llama_forward(
     # Logits stay in compute dtype: at 128k vocab an fp32 copy is the
     # single largest buffer in the step. The loss upcasts inside its
     # reductions (fp32 logsumexp) without materializing an fp32 tensor.
-    return _constrain(logits, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
+    logits = _constrain(logits, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
+    if return_embeds:
+        # final-hidden-state capture for speculator training (the
+        # reference's Embed* model variants + include_embeds flag,
+        # ref:speculator/train_speculator_utils.py:430-569)
+        return logits, x
+    return logits

@@ -1,0 +1,127 @@
+"""MLPSpeculator — the speculative-decoding head trained by the
+speculator pipeline.
+
+Functional port of the architecture the reference imports from fms-extras
+(ref:speculator/train_speculator.py:8-15, constructed with n_predict /
+inner width / tie-weights / scale-input knobs from the config,
+ref:config/training.py:63-70): a stack of ``n_predict`` small MLP
+predictors where head i refines a running state from (a) the previous
+state and (b) the embedding of the most recent known/predicted token,
+
+    state_i = gelu(LN_i(proj_i(state_{i-1}) * w_s + emb_i(tok_i) * w_e))
+    logits_i = head_i(state_i)
+
+with w_s = 0.5 ** (0.5 / n_predict) and w_e = sqrt(1 - w_s^2) keeping the
+state variance constant across heads. ``tie_weights`` shares emb/ln/head
+(and proj for i >= 1) across heads; ``scale_input`` layernorms the
+incoming base-model embedding (no affine) scaled by 1/sqrt(2).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class SpeculatorConfig:
+    emb_dim: int
+    inner_dim: int
+    vocab_size: int
+    n_predict: int
+    tie_weights: bool = True
+    scale_input: bool = True
+
+    @classmethod
+    def from_train_config(cls, cfg, emb_dim: int, vocab_size: int):
+        return cls(
+            emb_dim=emb_dim,
+            inner_dim=cfg.speculator_width,
+            vocab_size=vocab_size,
+            n_predict=cfg.n_speculator_heads,
+            tie_weights=cfg.speculator_tie_weights,
+            scale_input=cfg.speculator_scale_input,
+        )
+
+    def n_params(self) -> int:
+        n_unique = 1 if self.tie_weights else self.n_predict
+        n_proj = min(2, self.n_predict) if self.tie_weights else self.n_predict
+        proj = self.emb_dim * self.inner_dim + (n_proj - 1) * self.inner_dim**2
+        per_head = (
+            self.vocab_size * self.inner_dim  # emb
+            + 2 * self.inner_dim  # ln w, b
+            + self.inner_dim * self.vocab_size  # head
+        )
+        return int(n_unique * per_head + proj)
+
+
+def _layer_norm(x, weight=None, bias=None, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(axis=-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def init_speculator_params(key, scfg: SpeculatorConfig, dtype=jnp.float32) -> Params:
+    n_unique = 1 if scfg.tie_weights else scfg.n_predict
+    n_proj = min(2, scfg.n_predict) if scfg.tie_weights else scfg.n_predict
+    keys = jax.random.split(key, 2 * n_unique + n_proj)
+    ki = iter(keys)
+    std = 0.02
+
+    def tn(shape, s=std):
+        return (
+            jax.random.truncated_normal(next(ki), -3, 3, shape, jnp.float32) * s
+        ).astype(dtype)
+
+    projs = []
+    for i in range(n_proj):
+        in_dim = scfg.emb_dim if i == 0 else scfg.inner_dim
+        projs.append(tn((in_dim, scfg.inner_dim)))
+    return {
+        "emb": [tn((scfg.vocab_size, scfg.inner_dim)) for _ in range(n_unique)],
+        "proj": projs,
+        "ln_w": [jnp.ones((scfg.inner_dim,), dtype) for _ in range(n_unique)],
+        "ln_b": [jnp.zeros((scfg.inner_dim,), dtype) for _ in range(n_unique)],
+        "head": [tn((scfg.inner_dim, scfg.vocab_size)) for _ in range(n_unique)],
+    }
+
+
+def speculator_forward(params: Params, state, inds, scfg: SpeculatorConfig):
+    """state (B, N, emb_dim): base-model embeddings; inds (B, >= N +
+    n_predict - 1): known token indices, inds[:, i:i+N] feeding head i.
+    Returns per-head logits (n_predict, B, N, V)."""
+    n = state.shape[1]
+    state_weight = 0.5 ** (0.5 / scfg.n_predict)
+    emb_weight = (1 - state_weight**2) ** 0.5
+
+    if scfg.scale_input:
+        state = _layer_norm(state) * (2**-0.5)
+
+    def pick(group, i):
+        if scfg.tie_weights:
+            if group == "proj":
+                return params["proj"][min(i, len(params["proj"]) - 1)]
+            return params[group][0]
+        return params[group][i]
+
+    out = []
+    for i in range(scfg.n_predict):
+        tok = inds[:, i : i + n]
+        z = pick("emb", i)[tok].astype(state.dtype)
+        state = (
+            state @ pick("proj", i).astype(state.dtype) * state_weight
+            + z * emb_weight
+        )
+        state = jax.nn.gelu(_layer_norm(state, pick("ln_w", i), pick("ln_b", i)))
+        out.append(state @ pick("head", i).astype(state.dtype))
+
+    return jnp.stack(out, axis=0)

@@ -1,0 +1,311 @@
+"""Speculator training: stage-1/stage-2 losses, two-stage LR schedule, and
+the host loop (ref:speculator/train_speculator_utils.py:122-427).
+
+Stage 1 (steps <= stage2_start_step): one frozen-base forward over the
+batch yields embeddings in parallel; each speculator head is scored with
+CE against the ground-truth tokens it should predict.
+
+Stage 2: the frozen base *generates* (kv-cache sampling, models/generation)
+from short prompts carved out of the batch, and the speculator learns to
+match the base model's own output distribution.
+
+Both stages are jitted end-to-end; the base params are closed over and
+never differentiated. The reference's manual TP input all-gather / output
+chunking (ref:train_speculator_utils.py:327-338, 158-162, 224-232) has no
+analog here — inputs are global arrays and GSPMD handles any tensor axis.
+"""
+
+import math
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fms_fsdp_tpu.models.generation import generate
+from fms_fsdp_tpu.models.llama import llama_forward
+from fms_fsdp_tpu.models.speculator import SpeculatorConfig, speculator_forward
+from fms_fsdp_tpu.train.step import cross_entropy_loss
+
+
+def get_speculator_lr_schedule(cfg, start_step: int = 0):
+    """Two-stage schedule (ref:speculator/train_speculator.py:262-299):
+    stage 1 warms up then cosine-anneals to 10%; stage 2 restarts at 10%
+    of max, warms up, and anneals to 1%."""
+    s2_start = cfg.stage2_start_step
+    warmup1 = max(1, min(2000, s2_start // 20))
+    warmup2 = max(1, min(2000, (cfg.num_steps - s2_start) // 20))
+    s2_span = max(1, cfg.num_steps - s2_start)
+
+    def stage1(x):
+        wx = jnp.minimum(x, warmup1)
+        warm = 1 - (1 - wx / warmup1) ** 2
+        cos = 0.1 + 0.5 * (1 - 0.1) * (1 + jnp.cos(x / s2_start * jnp.pi))
+        return jnp.minimum(warm, cos)
+
+    def stage2(x):
+        wx = jnp.minimum(x, warmup2)
+        warm = 0.1 * (1 - (1 - wx / warmup2) ** 2)
+        cos = 0.01 + 0.05 * (1 - 0.1) * (
+            1 + jnp.cos(jnp.minimum(x, s2_span) / s2_span * jnp.pi)
+        )
+        return jnp.minimum(warm, cos)
+
+    def schedule(count):
+        x = count + start_step
+        return cfg.learning_rate * jnp.where(
+            x <= s2_start, stage1(x), stage2(x - s2_start)
+        )
+
+    return schedule
+
+
+def make_speculator_optimizer(cfg):
+    """AdamW (0.9, 0.95, wd 0.1), LR injected per step like the main path
+    (ref:speculator/train_speculator.py:234-239)."""
+    return optax.inject_hyperparams(optax.adamw)(
+        learning_rate=cfg.learning_rate, b1=0.9, b2=0.95, weight_decay=0.1
+    )
+
+
+def _per_head_ce(preds, targets_fn):
+    """preds (n, B, N, V); targets_fn(i) -> (B, N). Returns (total, per-head)."""
+    losses = []
+    for i in range(preds.shape[0]):
+        losses.append(cross_entropy_loss(preds[i], targets_fn(i)))
+    return sum(losses), jnp.stack(losses)
+
+
+def make_stage1_step(base_params, model_cfg, scfg: SpeculatorConfig, cfg, optimizer):
+    """(spec_state, input (B, L)) -> (spec_state, metrics). Ground-truth
+    feed: embeds over input[:, :-n-1], head i scored against
+    input[:, i+2 : N+i+2] (ref:train_speculator_utils.py:122-171)."""
+    n_predict = scfg.n_predict
+    schedule = get_speculator_lr_schedule(cfg)
+
+    def loss_fn(spec_params, inputs):
+        _, embeds = llama_forward(
+            base_params,
+            inputs[:, : -n_predict - 1],
+            model_cfg,
+            attn_impl=cfg.attention_kernel,
+            return_embeds=True,
+        )
+        embeds = jax.lax.stop_gradient(embeds)
+        preds = speculator_forward(spec_params, embeds, inputs[:, 1:], scfg)
+        n = preds.shape[2]
+        return _per_head_ce(preds, lambda i: inputs[:, i + 2 : n + i + 2])
+
+    @jax.jit
+    def step(state, inputs):
+        (loss, per_head), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], inputs
+        )
+        return _apply(
+            state, grads, optimizer, schedule, loss, per_head,
+            cfg.grad_clip_thresh,
+        )
+
+    return step
+
+
+def make_stage2_step(base_params, model_cfg, scfg: SpeculatorConfig, cfg, optimizer):
+    """Stage 2: base generates stage2_seq_length tokens from
+    stage2_prompt_length prompts (batch reshaped to stage2_batch_size rows),
+    and the speculator matches the generated stream
+    (ref:train_speculator_utils.py:175-242)."""
+    n_predict = scfg.n_predict
+    s2_prompt = cfg.stage2_prompt_length
+    s2_seq = cfg.stage2_seq_length
+    grow = cfg.stage2_batch_size // cfg.batch_size
+    assert s2_prompt * grow <= cfg.seq_length, (
+        "Error: batch is too small for specified partition"
+    )
+    schedule = get_speculator_lr_schedule(cfg)
+
+    def loss_fn(spec_params, inputs, key):
+        prompts = inputs[:, : s2_prompt * grow].reshape(-1, s2_prompt)
+        targs, embeds = generate(
+            base_params,
+            prompts,
+            model_cfg,
+            key=key,
+            max_seq_len=s2_prompt + s2_seq,
+            max_new_tokens=s2_seq,
+            do_sample=True,
+            include_embeds=True,
+        )
+        targs = jax.lax.stop_gradient(targs[:, -s2_seq:])
+        embeds = jax.lax.stop_gradient(embeds[:, : s2_seq - n_predict])
+        preds = speculator_forward(spec_params, embeds, targs[:, :-1], scfg)
+        n = preds.shape[2]
+        loss, per_head = _per_head_ce(
+            preds, lambda i: targs[:, i + 1 : n + i + 1]
+        )
+        return loss, per_head
+
+    @jax.jit
+    def step(state, inputs, key):
+        (loss, per_head), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state["params"], inputs, key
+        )
+        return _apply(
+            state, grads, optimizer, schedule, loss, per_head,
+            cfg.grad_clip_thresh,
+        )
+
+    return step
+
+
+def _apply(state, grads, optimizer, schedule, loss, per_head, clip_thresh=1.0):
+    gnorm = optax.global_norm(
+        jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+    )
+    clip = jnp.minimum(1.0, clip_thresh / (gnorm + 1e-6))
+    grads = jax.tree.map(lambda g: g * clip.astype(g.dtype), grads)
+    lr = schedule(state["step"])
+    opt_state = state["opt_state"]._replace(
+        hyperparams=dict(state["opt_state"].hyperparams, learning_rate=lr)
+    )
+    updates, opt_state = optimizer.update(grads, opt_state, state["params"])
+    params = optax.apply_updates(state["params"], updates)
+    new_state = {
+        "params": params,
+        "opt_state": opt_state,
+        "step": state["step"] + 1,
+    }
+    return new_state, {
+        "loss": loss,
+        "per_head": per_head,
+        "gnorm": gnorm,
+        "lr": lr,
+    }
+
+
+def do_ckpt(ckpt_save_path, reset=False):
+    """On-demand checkpoint flag: operator writes '1' to <save>/do_ckpt
+    (ref:train_speculator_utils.py:246-260)."""
+    ckpt_cmd_file = os.path.join(ckpt_save_path, "do_ckpt")
+    if not os.path.exists(ckpt_cmd_file):
+        return False
+    if reset:
+        with open(ckpt_cmd_file, "w") as fd:
+            fd.write("0")
+        return False
+    with open(ckpt_cmd_file) as fd:
+        return fd.read().strip() == "1"
+
+
+def train_speculator(
+    cfg,
+    base_params,
+    model_cfg,
+    spec_state,
+    scfg: SpeculatorConfig,
+    rank,
+    train_loader,
+    optimizer,
+    checkpointer,
+    start_step=0,
+    n_tok=0,
+    profiler=None,
+    ckpt_loader=None,
+):
+    """Speculator host loop with the reference's reporting/ckpt cadence
+    (ref:train_speculator_utils.py:263-427). ``train_loader`` yields global
+    input batches (e.g. a DeviceFeed); ``ckpt_loader`` is the stateful
+    pipeline object whose state gets checkpointed (defaults to
+    train_loader when it exposes save_to_path)."""
+    stage1 = make_stage1_step(base_params, model_cfg, scfg, cfg, optimizer)
+    stage2 = None  # built lazily: its batch-partition constraints only
+    # apply once stage 2 actually starts
+    key = jax.random.PRNGKey(cfg.seed + 17)
+    if ckpt_loader is None and hasattr(train_loader, "save_to_path"):
+        ckpt_loader = train_loader
+
+    # per-chip reporting normalizes by the data-parallel chip count
+    world_size = max(
+        1,
+        jax.device_count()
+        // max(1, getattr(cfg, "tensor_parallel_size", 1))
+        // max(1, getattr(cfg, "context_parallel_size", 1)),
+    )
+    window = []
+    elapsed_tokens = 0
+    start = time.time()
+    loop_start = time.time()
+    step_tok = 0
+
+    for batch_idx, inputs in enumerate(train_loader, start=start_step + 1):
+        if batch_idx > cfg.num_steps:
+            break
+        if isinstance(inputs, tuple):
+            inputs = inputs[0]
+        if not isinstance(inputs, jax.Array):
+            inputs = jnp.asarray(inputs, jnp.int32)
+
+        if batch_idx <= cfg.stage2_start_step:
+            spec_state, metrics = stage1(spec_state, inputs)
+            # global arrays: .size already counts the full global batch
+            step_tok = inputs.size
+        else:
+            if stage2 is None:
+                stage2 = make_stage2_step(
+                    base_params, model_cfg, scfg, cfg, optimizer
+                )
+            key, sub = jax.random.split(key)
+            spec_state, metrics = stage2(spec_state, inputs, sub)
+            grow = cfg.stage2_batch_size // cfg.batch_size
+            step_tok = inputs.shape[0] * grow * cfg.stage2_seq_length
+        window.append(metrics)
+
+        if profiler:
+            profiler.step()
+
+        if batch_idx % cfg.report_interval == 0:
+            fetched = jax.device_get(window)
+            window = []
+            per_head = np.mean([m["per_head"] for m in fetched], axis=0)
+            g_norm = float(np.mean([m["gnorm"] for m in fetched]))
+            elapsed_time = time.time() - loop_start
+            elapsed_tokens += cfg.report_interval * step_tok
+            if rank == 0:
+                print(f"{time.time()}")
+                print("step:", batch_idx)
+                print("tokens seen:", n_tok + elapsed_tokens)
+                for i in range(len(per_head)):
+                    print(f"loss {i + 1}:", float(per_head[i]))
+                print("gradient norm:", g_norm)
+                print(
+                    f"speed for these {cfg.report_interval} steps:",
+                    (time.time() - start) / cfg.report_interval,
+                )
+                print("overall speed:", elapsed_time / (batch_idx - start_step))
+                print("LR:", float(fetched[-1]["lr"]))
+                print(
+                    "overall token per gpu per sec:",
+                    int(elapsed_tokens / world_size / elapsed_time),
+                )
+                print(
+                    "token per day:",
+                    int(elapsed_tokens / elapsed_time * 3600 * 24),
+                )
+                print()
+            start = time.time()
+
+        if (
+            batch_idx % cfg.checkpoint_interval == 0
+            or batch_idx == cfg.num_steps
+            or do_ckpt(cfg.ckpt_save_path) is True
+        ):
+            checkpointer.save(
+                batch_idx,
+                spec_state,
+                ckpt_loader,
+                tokens_seen=elapsed_tokens + n_tok,
+            )
+            do_ckpt(cfg.ckpt_save_path, reset=True)
+
+    return spec_state

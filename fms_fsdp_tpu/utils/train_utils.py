@@ -26,7 +26,25 @@ def setup():
     ref:train_utils.py:183-184). Initializes on any multi-host signal:
     an explicit coordinator, a multi-worker TPU pod env, or NUM_PROCESSES.
     No-op on single-host runs (Orbax's multi-process commit protocol is
-    only needed — and only engaged — when process_count > 1)."""
+    only needed — and only engaged — when process_count > 1).
+
+    Also honors ``--xla_force_host_platform_device_count`` from XLA_FLAGS
+    via jax.config when running on CPU: site customizations that import
+    jax early (TPU plugin registration) can otherwise swallow the flag,
+    silently collapsing the virtual test mesh to one device."""
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+    if m and os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        try:
+            # both updates are required: the env var alone loses to
+            # early-imported platform plugins, and the device count only
+            # applies to a CPU client created after the config round-trips
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+        except Exception:
+            pass  # backend already initialized; flag may still have applied
     hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     multihost = (
         os.environ.get("COORDINATOR_ADDRESS")

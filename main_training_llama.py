@@ -18,6 +18,7 @@ import jax
 from fms_fsdp_tpu.config import TrainConfig
 from fms_fsdp_tpu.data import get_data_loader, get_dummy_loader
 from fms_fsdp_tpu.data.device_feed import DeviceFeed
+from fms_fsdp_tpu.data.loader import rebatch
 from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
 from fms_fsdp_tpu.train.step import (
     init_train_state,
@@ -107,7 +108,7 @@ def main(**kwargs):
     profiler = get_profiler(cfg, rank)
 
     # batch loop: stack per-rank batches to the local device batch
-    feed = DeviceFeed(_rebatch(loader, local_batch, cfg.batch_size), mesh, prefetch=2)
+    feed = DeviceFeed(rebatch(loader, local_batch, cfg.batch_size), mesh, prefetch=2)
 
     if rank == 0:
         print(f"Training for {cfg.num_steps} steps")
@@ -122,27 +123,6 @@ def main(**kwargs):
         start_step,
         tokens_seen,
     )
-
-
-def _rebatch(loader, local_batch: int, batch_size: int):
-    """Concatenate loader batches (of per-rank batch_size) up to the
-    process-local device batch."""
-    if local_batch == batch_size:
-        return loader
-
-    def gen():
-        import numpy as np
-
-        it = iter(loader)
-        n = local_batch // batch_size
-        while True:
-            parts = [next(it) for _ in range(n)]
-            if isinstance(parts[0], tuple):
-                yield tuple(np.concatenate(f) for f in zip(*parts))
-            else:
-                yield np.concatenate(parts)
-
-    return gen()
 
 
 if __name__ == "__main__":

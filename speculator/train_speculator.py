@@ -1,0 +1,170 @@
+"""Speculator training entry point (ref:speculator/train_speculator.py:107-326).
+
+Sequence: config -> mesh -> frozen base model (loaded from
+cfg.model_path) -> sanity generation test -> MLPSpeculator (replicated —
+the NO_SHARD analog) -> dataloader (raw packed sequences, no causal
+shift) -> two-stage training loop.
+
+Run:  python speculator/train_speculator.py --model_variant=llama2_7b \\
+          --model_path=/path/to/ckpt --use_dummy_dataset=True ...
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fms_fsdp_tpu.config import TrainConfig
+from fms_fsdp_tpu.data import get_data_loader, get_dummy_loader
+from fms_fsdp_tpu.data.device_feed import DeviceFeed
+from fms_fsdp_tpu.data.loader import rebatch
+from fms_fsdp_tpu.models.generation import generate
+from fms_fsdp_tpu.models.llama import init_llama_params
+from fms_fsdp_tpu.models.speculator import (
+    SpeculatorConfig,
+    init_speculator_params,
+)
+from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+from fms_fsdp_tpu.parallel.sharding import llama_param_specs, shard_params
+from fms_fsdp_tpu.train.speculator import (
+    make_speculator_optimizer,
+    train_speculator,
+)
+from fms_fsdp_tpu.utils.checkpointing import Checkpointer
+from fms_fsdp_tpu.utils.cli import parse_cli_args
+from fms_fsdp_tpu.utils.config_utils import get_model_config, update_config
+from fms_fsdp_tpu.utils.train_utils import (
+    get_profiler,
+    setup,
+    setup_environ_flags,
+)
+
+
+def test_model(rank, base_params, model_cfg, cfg):
+    """Sanity generation check on the loaded base model
+    (ref:speculator/train_speculator.py:34-60 analog)."""
+    prompt = jnp.arange(16, dtype=jnp.int32)[None, :] % model_cfg.src_vocab_size
+    out = generate(
+        base_params,
+        prompt,
+        model_cfg,
+        key=jax.random.PRNGKey(0),
+        max_seq_len=64,
+        max_new_tokens=8,
+        do_sample=False,
+        include_embeds=False,
+    )
+    if rank == 0:
+        print(f"{time.time()} sanity generation:", np.asarray(out[0, -8:]))
+
+
+def main(**kwargs):
+    cfg = TrainConfig()
+    update_config(cfg, **kwargs)
+    # room for the ground-truth targets of every head
+    cfg.seq_length = cfg.seq_length + cfg.n_speculator_heads + 1
+
+    setup()
+    setup_environ_flags()
+    rank = jax.process_index()
+    world_size = jax.process_count()
+    if rank == 0:
+        print(f"{time.time()} running with these configs {cfg}")
+
+    # base-model mesh: "tp" shards the base over the tensor axis
+    # (ref:train_speculator.py:133-142); other strategies shard FSDP-style
+    mesh_cfg = MeshConfig(
+        sharding_strategy=cfg.sharding_strategy,
+        sharding_group_size=cfg.sharding_group_size,
+        tensor_parallel_size=cfg.tp_size if cfg.sharding_strategy == "tp" else 1,
+    )
+    mesh = build_mesh(mesh_cfg)
+
+    # frozen base model
+    model_cfg = get_model_config(cfg.model_variant)
+    update_config(model_cfg, **kwargs)
+    base_params = init_llama_params(
+        jax.random.PRNGKey(cfg.seed), model_cfg, dtype=jnp.bfloat16
+    )
+    base_params = shard_params(base_params, llama_param_specs(), mesh)
+    if cfg.model_path and os.path.exists(cfg.model_path):
+        loader_ck = Checkpointer(
+            os.path.join(cfg.ckpt_save_path, "_base_load"), 1, "ddp", rank
+        )
+        state = {"params": base_params}
+        state, _, _, _, _ = loader_ck.load(state, None, path=cfg.model_path)
+        base_params = state["params"]
+    elif rank == 0:
+        print(
+            f"No base checkpoint at {cfg.model_path}; using random init "
+            "(smoke-test mode)"
+        )
+
+    test_model(rank, base_params, model_cfg, cfg)
+
+    # speculator (replicated: NO_SHARD analog, ref:train_speculator.py:201)
+    scfg = SpeculatorConfig.from_train_config(
+        cfg, emb_dim=model_cfg.emb_dim, vocab_size=model_cfg.src_vocab_size
+    )
+    spec_params = init_speculator_params(jax.random.PRNGKey(cfg.seed + 1), scfg)
+    if rank == 0:
+        print(
+            f"\n{time.time()} speculator has {scfg.n_params() / 1e6} "
+            "Million params\n"
+        )
+
+    # data: raw packed sequences (no causal shift), assembled into global
+    # mesh-sharded batches covering the data-parallel extent
+    if not cfg.use_dummy_dataset:
+        train_loader = get_data_loader(cfg, rank, world_size, postprocess=[])
+    else:
+        train_loader = get_dummy_loader(cfg, rank, world_size)
+    data_extent = mesh.shape["replica"] * mesh.shape["fsdp"]
+    local_batch = cfg.batch_size * max(1, data_extent // world_size)
+    feed = DeviceFeed(
+        rebatch(train_loader, local_batch, cfg.batch_size), mesh, prefetch=2
+    )
+
+    optimizer = make_speculator_optimizer(cfg)
+    spec_state = {
+        "params": spec_params,
+        "opt_state": optimizer.init(spec_params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+    checkpointer = Checkpointer(cfg.ckpt_save_path, 1000, "ddp", rank)
+    ckpt_loader = train_loader if hasattr(train_loader, "save_to_path") else None
+    spec_state, _, start_step, tokens_seen, _ = checkpointer.load(
+        spec_state,
+        ckpt_loader,
+        path=os.path.join(cfg.ckpt_load_path, "checkpoints/"),
+    )
+
+    profiler = get_profiler(cfg, rank)
+
+    if rank == 0:
+        print(f"{time.time()} Training for {cfg.num_steps} steps")
+    train_speculator(
+        cfg,
+        base_params,
+        model_cfg,
+        spec_state,
+        scfg,
+        rank,
+        iter(feed),
+        optimizer,
+        checkpointer,
+        start_step,
+        tokens_seen,
+        profiler,
+        ckpt_loader=ckpt_loader,
+    )
+
+
+if __name__ == "__main__":
+    main(**parse_cli_args(sys.argv[1:]))
