@@ -42,12 +42,21 @@ def _decode_attention(q, k_cache, v_cache, cur_pos):
     return out.reshape(b, 1, nq, h)
 
 
-def prefill(params, tokens, cfg: LlamaConfig, max_seq_len: int, compute_dtype=jnp.bfloat16):
+def prefill(
+    params,
+    tokens,
+    cfg: LlamaConfig,
+    max_seq_len: int,
+    compute_dtype=jnp.bfloat16,
+    full_logits: bool = False,
+):
     """Run the prompt through the model, building the kv cache.
 
-    Returns (logits (B, S, V), embeds (B, S, D), cache). The cache holds
-    max_seq_len positions; positions >= len(prompt) are zeros until decode
-    writes them.
+    Returns (logits, embeds (B, S, D), cache). ``logits`` covers only the
+    final position (B, 1, V) unless ``full_logits`` — generation discards
+    the rest, and at 128k vocab the full (B, S, V) matmul is pure waste.
+    The cache holds max_seq_len positions; positions >= len(prompt) are
+    zeros until decode writes them.
     """
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     b, s = tokens.shape
@@ -76,7 +85,8 @@ def prefill(params, tokens, cfg: LlamaConfig, max_seq_len: int, compute_dtype=jn
 
     x, (k_cache, v_cache) = lax.scan(body, x, params["layers"])
     embeds = rms_norm(x, params["norm"], cfg.norm_eps)
-    logits = embeds @ params["lm_head"]
+    src = embeds if full_logits else embeds[:, -1:]
+    logits = src @ params["lm_head"]
     return logits, embeds, {"k": k_cache, "v": v_cache}
 
 
@@ -160,6 +170,11 @@ def generate(
     predicted the NEXT token), matching the reference's embeds capture.
     """
     b, prompt_len = input_ids.shape
+    assert prompt_len + max_new_tokens <= max_seq_len, (
+        f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) exceeds "
+        f"max_seq_len ({max_seq_len}): the kv cache would overflow (dynamic "
+        "slice writes clamp silently)"
+    )
     logits, prefill_embeds, cache = prefill(params, input_ids, cfg, max_seq_len)
     last_logits = logits[:, -1]
     last_embed = prefill_embeds[:, -1]

@@ -129,7 +129,14 @@ def _llama_block(
     k = _constrain(k, head_spec, mesh)
     q = apply_rotary(q, cos, sin)
     k = apply_rotary(k, cos, sin)
-    o = attention(q, k, v, causal=True, impl=attn_impl)
+    if mesh is not None and mesh.shape[AXIS_CONTEXT] > 1:
+        # sequence sharded over the context axis: ring attention keeps
+        # kv O(S/cp) per device instead of letting GSPMD all-gather it
+        from fms_fsdp_tpu.ops.ring_attention import ring_attention
+
+        o = ring_attention(q, k, v, mesh, causal=True)
+    else:
+        o = attention(q, k, v, causal=True, impl=attn_impl)
     o = o.reshape(b, s, nq * hd) @ layer["wo"]
     x = x + _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 
@@ -166,6 +173,8 @@ def llama_forward(
     x = params["embedding"][tokens]
     x = _constrain(x, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 
+    # RoPE positions are global; with a context axis the constraint above
+    # keeps tokens sharded but positions stay absolute (table is replicated)
     seq_len = tokens.shape[1]
     cos, sin = rope_table(seq_len, cfg.head_dim, cfg.rope_theta)
 

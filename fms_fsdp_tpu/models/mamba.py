@@ -181,7 +181,12 @@ def _attn_mixer(x, p: Params, cfg: MambaConfig, cos, sin, attn_impl, mesh):
         q = apply_rotary(q, cos, sin)
         k = apply_rotary(k, cos, sin)
 
-    o = attention(q, k, v, causal=a.causal, impl=attn_impl)
+    if mesh is not None and mesh.shape[AXIS_CONTEXT] > 1:
+        from fms_fsdp_tpu.ops.ring_attention import ring_attention
+
+        o = ring_attention(q, k, v, mesh, causal=a.causal)
+    else:
+        o = attention(q, k, v, causal=a.causal, impl=attn_impl)
     o = o.reshape(B, S, a.num_heads * hd) @ p["wo"]
     return _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 

@@ -1,0 +1,117 @@
+"""Ring attention: causal attention with the sequence sharded over the
+"context" mesh axis.
+
+Beyond-reference capability (the reference has no sequence/context
+parallelism, SURVEY.md §2.b): each device holds S/cp query and kv chunks;
+kv chunks rotate around the ring via ``lax.ppermute`` while every device
+accumulates its queries' attention over each visiting chunk with the
+online-softmax merge (running max / denominator, fp32) — so attention
+memory stays O(S/cp) per device and bandwidth rides the ICI ring.
+
+Chunk-level masking uses global positions, so the same code handles the
+diagonal, fully-visible, and fully-masked chunk relations without static
+branching. Composes with GQA and the tensor axis (heads split by
+shard_map). The per-chunk partial uses an einsum (scores materialized at
+(S/cp)^2 per device per step); swapping it for the Pallas flash kernel is
+a local change once block-level lse outputs are exposed.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_TENSOR, DATA_AXES
+
+NEG_INF = -1e30
+
+
+def _chunk_partial(q, k, v, q_off, k_off, causal, scale):
+    """Partial attention of local q against one kv chunk at global offset
+    k_off. Returns (o_part, m, l) with o_part = exp(s - m) @ v."""
+    b, sq, nq, h = q.shape
+    nkv = k.shape[2]
+    group = nq // nkv
+    qg = q.reshape(b, sq, nkv, group, h)
+    s = (
+        jnp.einsum(
+            "bqkgh,bskh->bkgqs", qg, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
+    if causal:
+        qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 0)
+        kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, NEG_INF)  # keep fully-masked rows finite
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bkgqs,bskh->bkgqh", p.astype(v.dtype), v)
+    return o.astype(jnp.float32), m, l
+
+
+def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
+    """q (B, S, Nq, H), k/v (B, S, Nkv, H) — S sharded over AXIS_CONTEXT."""
+    scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
+    cp = mesh.shape[AXIS_CONTEXT]
+    assert q.shape[1] % cp == 0, (
+        f"sequence length {q.shape[1]} must divide the context axis ({cp})"
+    )
+    from fms_fsdp_tpu.parallel.sharding import resolve_spec
+
+    # batch/tensor dims that don't divide their mesh axes fall back to
+    # replicated (the op's contract is the context axis; the others are
+    # opportunistic)
+    base = P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR, None)
+    spec_q = resolve_spec(base, q.shape, mesh)
+    spec_kv = resolve_spec(base, k.shape, mesh)
+    assert spec_q[1] == AXIS_CONTEXT and spec_kv[1] == AXIS_CONTEXT
+    if spec_q[2] != spec_kv[2]:
+        # q heads divide the tensor axis but kv heads don't (or vice
+        # versa): a split would mispair GQA groups — replicate heads
+        spec_q = P(spec_q[0], spec_q[1], None, None)
+        spec_kv = P(spec_kv[0], spec_kv[1], None, None)
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec_q, spec_kv, spec_kv),
+        out_specs=spec_q,
+        check_rep=False,
+    )
+    def inner(q, k, v):
+        idx = lax.axis_index(AXIS_CONTEXT)
+        b, s_local, nq, h = q.shape
+        nkv = k.shape[2]
+        group = nq // nkv
+        q_off = idx * s_local
+
+        def body(step, carry):
+            acc, m_run, l_run, k_cur, v_cur = carry
+            src = (idx - step) % cp  # global chunk currently held
+            k_off = src * s_local
+            o, m, l = _chunk_partial(q, k_cur, v_cur, q_off, k_off, causal, scale)
+            m_new = jnp.maximum(m_run, m)
+            alpha = jnp.exp(m_run - m_new)
+            beta = jnp.exp(m - m_new)
+            acc = acc * alpha + o * beta
+            l_run = l_run * alpha + l * beta
+            # rotate kv to the next device (last rotation restores state)
+            k_cur = lax.ppermute(k_cur, AXIS_CONTEXT, perm)
+            v_cur = lax.ppermute(v_cur, AXIS_CONTEXT, perm)
+            return acc, m_new, l_run, k_cur, v_cur
+
+        acc = jnp.zeros((b, nkv, group, s_local, h), jnp.float32)
+        m0 = jnp.full((b, nkv, group, s_local, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, nkv, group, s_local, 1), jnp.float32)
+        acc, m0, l0, _, _ = lax.fori_loop(0, cp, body, (acc, m0, l0, k, v))
+        out = acc / jnp.maximum(l0, 1e-30)
+        out = jnp.moveaxis(out, 3, 1)  # (b, s, nkv, group, h)
+        return out.reshape(b, s_local, nq, h).astype(q.dtype)
+
+    return inner(q, k, v)

@@ -1,0 +1,161 @@
+"""Convert a framework Llama checkpoint to HuggingFace format
+(ref:fms_to_hf_llama.py:11-167).
+
+The reference must split fms's fused qkv / gate-up projections and
+un-permute the interleaved rotary layout (ref:fms_to_hf_llama.py:69-124);
+our native layout already matches HF's conventions (separate projections,
+half-split rotary), so conversion is transposes + naming:
+
+    embedding (V, D)        -> model.embed_tokens.weight
+    layers.wq[i] (D, N*hd)  -> model.layers.i.self_attn.q_proj.weight^T
+    layers.w1[i] (D, H)     -> model.layers.i.mlp.gate_proj.weight^T
+    ...
+    lm_head (D, V)          -> lm_head.weight^T
+
+Usage:
+    python fms_to_hf_llama.py --model_variant=llama2_7b \\
+        --load_path=/ckpts/checkpoints/step_1000_ckp \\
+        --save_path=/out/hf_model [--tokenizer_name_or_path=/tok]
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from fms_fsdp_tpu.models.configs import LlamaConfig
+from fms_fsdp_tpu.utils.cli import parse_cli_args
+from fms_fsdp_tpu.utils.config_utils import get_model_config, update_config
+
+
+def params_to_hf_state_dict(params, cfg: LlamaConfig):
+    """Our param pytree -> HF LlamaForCausalLM state dict (numpy arrays,
+    fp32)."""
+
+    def t(x):
+        return np.asarray(x, dtype=np.float32).T
+
+    sd = {
+        "model.embed_tokens.weight": np.asarray(
+            params["embedding"], dtype=np.float32
+        ),
+        "model.norm.weight": np.asarray(params["norm"], dtype=np.float32),
+        "lm_head.weight": t(params["lm_head"]),
+    }
+    L = np.asarray(params["layers"]["wq"]).shape[0]
+    for i in range(L):
+        lp = f"model.layers.{i}"
+        layer = {k: np.asarray(v[i]) for k, v in params["layers"].items()}
+        sd[f"{lp}.self_attn.q_proj.weight"] = t(layer["wq"])
+        sd[f"{lp}.self_attn.k_proj.weight"] = t(layer["wk"])
+        sd[f"{lp}.self_attn.v_proj.weight"] = t(layer["wv"])
+        sd[f"{lp}.self_attn.o_proj.weight"] = t(layer["wo"])
+        sd[f"{lp}.mlp.gate_proj.weight"] = t(layer["w1"])
+        sd[f"{lp}.mlp.up_proj.weight"] = t(layer["w3"])
+        sd[f"{lp}.mlp.down_proj.weight"] = t(layer["w2"])
+        sd[f"{lp}.input_layernorm.weight"] = np.asarray(
+            layer["attn_norm"], dtype=np.float32
+        )
+        sd[f"{lp}.post_attention_layernorm.weight"] = np.asarray(
+            layer["ffn_norm"], dtype=np.float32
+        )
+    return sd
+
+
+def hf_config(cfg: LlamaConfig):
+    from transformers import LlamaConfig as HFLlamaConfig
+
+    return HFLlamaConfig(
+        vocab_size=cfg.src_vocab_size,
+        hidden_size=cfg.emb_dim,
+        intermediate_size=cfg.hidden_dim,
+        num_hidden_layers=cfg.nlayers,
+        num_attention_heads=cfg.nheads,
+        num_key_value_heads=cfg.n_kv_heads,
+        max_position_embeddings=cfg.max_expected_seq_len,
+        rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta,
+        tie_word_embeddings=False,
+    )
+
+
+def convert_to_hf(params, cfg: LlamaConfig):
+    """Build a transformers LlamaForCausalLM carrying our weights."""
+    import torch
+    from transformers import LlamaForCausalLM
+
+    model = LlamaForCausalLM(hf_config(cfg))
+    sd = {
+        k: torch.from_numpy(np.ascontiguousarray(v))
+        for k, v in params_to_hf_state_dict(params, cfg).items()
+    }
+    model.load_state_dict(sd, strict=True)
+    return model
+
+
+def load_params(load_path: str, cfg: LlamaConfig):
+    """Load params from an orbax checkpoint dir (step_N_ckp or its parent)
+    or a single-file pickle."""
+    import pickle
+
+    import jax
+
+    if os.path.isfile(load_path):
+        with open(load_path, "rb") as f:
+            payload = pickle.load(f)
+        return payload.get("model_state", payload)
+
+    import orbax.checkpoint as ocp
+
+    from fms_fsdp_tpu.config import TrainConfig
+    from fms_fsdp_tpu.models.llama import init_llama_params
+    from fms_fsdp_tpu.train.step import make_optimizer
+
+    # full state structure (params + optimizer) mirrors what training saved
+    optimizer = make_optimizer(TrainConfig())
+
+    def init_fn(k):
+        import jax.numpy as jnp
+
+        params = init_llama_params(k, cfg)
+        return {
+            "params": params,
+            "opt_state": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    target = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+
+    state_dir = os.path.join(load_path, "state")
+    if not os.path.isdir(state_dir):
+        # maybe a checkpoints/ folder: pick the newest step dir
+        from fms_fsdp_tpu.utils.ckpt_paths import get_latest
+
+        latest = get_latest(load_path)
+        assert latest is not None, f"no checkpoint under {load_path}"
+        state_dir = os.path.join(latest, "state")
+    restored = ocp.StandardCheckpointer().restore(state_dir, target)
+    return restored["params"]
+
+
+def main(**kwargs):
+    cfg = get_model_config(kwargs.get("model_variant", "llama2_7b"))
+    update_config(cfg, **kwargs)
+    load_path = kwargs["load_path"]
+    save_path = kwargs["save_path"]
+
+    params = load_params(load_path, cfg)
+    model = convert_to_hf(params, cfg)
+    model.save_pretrained(save_path, safe_serialization=True)
+    print(f"HF model saved to {save_path}")
+
+    tok = kwargs.get("tokenizer_name_or_path")
+    if tok:
+        from transformers import AutoTokenizer
+
+        AutoTokenizer.from_pretrained(tok).save_pretrained(save_path)
+        print("Tokenizer copied.")
+
+
+if __name__ == "__main__":
+    main(**parse_cli_args(sys.argv[1:]))

@@ -1,0 +1,149 @@
+"""Export a framework Mamba hybrid checkpoint to the mamba_ssm /
+MambaLMHeadModel ``save_pretrained`` layout (ref:fms_to_hf_mamba.py:9-33):
+a directory holding ``config.json`` (the MambaConfig dict) and
+``pytorch_model.bin`` with mamba_ssm's parameter naming —
+
+    backbone.embedding.weight
+    backbone.layers.N.norm.weight / .norm2.weight
+    backbone.layers.N.mixer.{in_proj,conv1d,dt_bias,A_log,D,norm,out_proj}
+    backbone.layers.N.mixer.{in_proj (qkv fused),out_proj}  (attn layers)
+    backbone.layers.N.mlp.{fc1 (up|gate fused),fc2}
+    backbone.norm_f.weight, lm_head.weight
+
+Usage:
+    python fms_to_hf_mamba.py --load_path=... --save_path=...
+"""
+
+import json
+import os
+import sys
+from dataclasses import asdict
+
+import numpy as np
+
+from fms_fsdp_tpu.models.configs import MambaConfig
+from fms_fsdp_tpu.utils.cli import parse_cli_args
+from fms_fsdp_tpu.utils.config_utils import get_model_config, update_config
+
+
+def params_to_mamba_ssm_state_dict(params, cfg: MambaConfig):
+    """Our pytree -> mamba_ssm-style state dict (numpy fp32)."""
+
+    def a(x):
+        return np.asarray(x, dtype=np.float32)
+
+    def t(x):
+        return a(x).T
+
+    sd = {
+        "backbone.embedding.weight": a(params["embedding"]),
+        "backbone.norm_f.weight": a(params["norm_f"]),
+        "lm_head.weight": t(params["lm_head"]),
+    }
+    for i, layer in enumerate(params["layers"]):
+        lp = f"backbone.layers.{i}"
+        sd[f"{lp}.norm.weight"] = a(layer["norm"])
+        m = layer["mixer"]
+        if i in cfg.attn_layer_idx:
+            # mamba_ssm MHA: fused in_proj (out_features = (nq + 2*nkv) * hd)
+            wqkv = np.concatenate([t(m["wq"]), t(m["wk"]), t(m["wv"])], axis=0)
+            sd[f"{lp}.mixer.in_proj.weight"] = wqkv
+            sd[f"{lp}.mixer.out_proj.weight"] = t(m["wo"])
+        else:
+            sd[f"{lp}.mixer.in_proj.weight"] = t(m["in_proj"])
+            # torch conv1d weight layout: (channels, 1, width)
+            sd[f"{lp}.mixer.conv1d.weight"] = a(m["conv_w"])[:, None, :]
+            sd[f"{lp}.mixer.conv1d.bias"] = a(m["conv_b"])
+            sd[f"{lp}.mixer.dt_bias"] = a(m["dt_bias"])
+            sd[f"{lp}.mixer.A_log"] = a(m["A_log"])
+            sd[f"{lp}.mixer.D"] = a(m["D"])
+            sd[f"{lp}.mixer.norm.weight"] = a(m["norm"])
+            sd[f"{lp}.mixer.out_proj.weight"] = t(m["out_proj"])
+        if "mlp" in layer:
+            sd[f"{lp}.norm2.weight"] = a(layer["norm2"])
+            # mamba_ssm GatedMLP: fc1 output chunks as (y, gate) with the
+            # activation on the SECOND chunk -> rows are [up (w3); gate (w1)]
+            fc1 = np.concatenate([t(layer["mlp"]["w3"]), t(layer["mlp"]["w1"])], axis=0)
+            sd[f"{lp}.mlp.fc1.weight"] = fc1
+            sd[f"{lp}.mlp.fc2.weight"] = t(layer["mlp"]["w2"])
+    return sd
+
+
+def mamba_ssm_config_dict(cfg: MambaConfig) -> dict:
+    """The MambaConfig dict format mamba_ssm consumes
+    (ref:config_utils.py:162-185)."""
+    return {
+        "d_model": cfg.d_model,
+        "d_intermediate": cfg.d_intermediate,
+        "n_layer": cfg.n_layer,
+        "vocab_size": cfg.vocab_size,
+        "ssm_cfg": {"layer": cfg.ssm_layer},
+        "attn_layer_idx": list(cfg.attn_layer_idx),
+        "attn_cfg": asdict(cfg.attn_cfg),
+        "rms_norm": cfg.rms_norm,
+        "residual_in_fp32": cfg.residual_in_fp32,
+        "fused_add_norm": cfg.fused_add_norm,
+        "pad_vocab_size_multiple": cfg.pad_vocab_size_multiple,
+        "tie_embeddings": cfg.tie_embeddings,
+    }
+
+
+def save_pretrained(params, cfg: MambaConfig, save_path: str):
+    import torch
+
+    os.makedirs(save_path, exist_ok=True)
+    sd = {
+        k: torch.from_numpy(np.ascontiguousarray(v))
+        for k, v in params_to_mamba_ssm_state_dict(params, cfg).items()
+    }
+    torch.save(sd, os.path.join(save_path, "pytorch_model.bin"))
+    with open(os.path.join(save_path, "config.json"), "w") as f:
+        json.dump(mamba_ssm_config_dict(cfg), f, indent=2)
+
+
+def main(**kwargs):
+    import pickle
+
+    cfg = get_model_config(kwargs.get("model_variant", "mamba_9.8b"))
+    update_config(cfg, **kwargs)
+    load_path = kwargs["load_path"]
+    save_path = kwargs["save_path"]
+
+    if os.path.isfile(load_path):
+        with open(load_path, "rb") as f:
+            payload = pickle.load(f)
+        params = payload.get("model_state", payload)
+    else:
+        import jax
+        import jax.numpy as jnp
+        import orbax.checkpoint as ocp
+
+        from fms_fsdp_tpu.config import TrainConfig
+        from fms_fsdp_tpu.models.mamba import init_mamba_params
+        from fms_fsdp_tpu.train.step import make_optimizer
+        from fms_fsdp_tpu.utils.ckpt_paths import get_latest
+
+        optimizer = make_optimizer(TrainConfig())
+
+        def init_fn(k):
+            params = init_mamba_params(k, cfg)
+            return {
+                "params": params,
+                "opt_state": optimizer.init(params),
+                "step": jnp.zeros((), jnp.int32),
+            }
+
+        target = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        state_dir = os.path.join(load_path, "state")
+        if not os.path.isdir(state_dir):
+            latest = get_latest(load_path)
+            assert latest is not None, f"no checkpoint under {load_path}"
+            state_dir = os.path.join(latest, "state")
+        params = ocp.StandardCheckpointer().restore(state_dir, target)["params"]
+
+    save_pretrained(params, cfg, save_path)
+    print(f"mamba_ssm-format model saved to {save_path}")
+
+
+if __name__ == "__main__":
+    main(**parse_cli_args(sys.argv[1:]))

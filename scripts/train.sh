@@ -1,0 +1,30 @@
+#!/bin/bash
+# Multi-host TPU launch (ref:scripts/train.sh torchrun analog).
+# Run this same script on every host of the pod slice (e.g. via
+# `gcloud compute tpus tpu-vm ssh --worker=all --command="bash train.sh"`);
+# JAX picks up host topology from the TPU pod environment and
+# jax.distributed initializes one process per host.
+
+set -euo pipefail
+
+MODEL_ARGS="\
+--model_variant=llama2_7b
+--ckpt_load_path=/ckpts
+--ckpt_save_path=/ckpts
+--data_path=/data
+--file_type=arrow
+--datasets=dataset=commoncrawl,dataset=webhose
+--weights=7725,500
+--seq_length=4096
+--vocab_size=32000
+--logical_shards=1024
+--sharding_strategy=hsdp
+--fsdp_activation_checkpointing=False
+--batch_size=2
+--learning_rate=3e-4
+--num_steps=1000000
+--report_interval=100
+--checkpoint_interval=10000
+"
+
+python main_training_llama.py ${MODEL_ARGS} "$@"

@@ -1,0 +1,24 @@
+#!/bin/bash
+# Speculator training launch (ref:scripts/train_speculator.sh analog).
+
+set -euo pipefail
+
+SPEC_ARGS="\
+--model_variant=llama2_7b
+--model_path=/ckpts/base
+--ckpt_load_path=/spec_ckpts
+--ckpt_save_path=/spec_ckpts
+--data_path=/data
+--sharding_strategy=tp
+--tp_size=8
+--batch_size=8
+--seq_length=4096
+--n_speculator_heads=3
+--speculator_width=4096
+--stage2_start_step=15000
+--num_steps=30000
+--report_interval=100
+--checkpoint_interval=2000
+"
+
+python speculator/train_speculator.py ${SPEC_ARGS} "$@"

@@ -43,7 +43,8 @@ def test_prefill_matches_forward(base_params):
         base_params, tokens, TINY, attn_impl="xla", compute_dtype=jnp.float32
     )
     logits, embeds, cache = prefill(
-        base_params, tokens, TINY, max_seq_len=32, compute_dtype=jnp.float32
+        base_params, tokens, TINY, max_seq_len=32, compute_dtype=jnp.float32,
+        full_logits=True,
     )
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(logits_ref), atol=1e-4
