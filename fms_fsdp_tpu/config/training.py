@@ -58,6 +58,12 @@ class TrainConfig:
     scan_layers: bool = True  # lax.scan over the layer stack (fast compiles)
     attention_kernel: str = "auto"  # "auto" | "pallas" | "xla"
     mamba_kernel: str = "auto"  # "auto" | "pallas" | "xla"
+    # Chunked lm-head+CE (never materializes (B,S,V) logits). Costs one
+    # extra lm-head pass (~+33% of lm-head FLOPs): a win for models where
+    # the head is a small fraction (7B+ at 32k vocab) or when logits memory
+    # forces remat; a loss for small embedding-heavy models.
+    fused_loss: bool = False
+    loss_chunk_size: int = 4096  # tokens per fused-loss logits tile
 
     # training spec (ref:fms_fsdp/config/training.py:37-43)
     batch_size: int = 2

@@ -159,6 +159,7 @@ def llama_forward(
     scan_layers: bool = True,
     mesh: Optional[Mesh] = None,
     return_embeds: bool = False,
+    return_hidden: bool = False,
 ):
     """tokens (B, S) int32 -> logits (B, S, V) in the compute dtype.
 
@@ -200,6 +201,10 @@ def llama_forward(
             x = (remat_block if ac_mask[i] else block)(x, layer)
 
     x = rms_norm(x, params["norm"], cfg.norm_eps)
+    if return_hidden:
+        # final hidden states only — the fused lm-head+CE loss consumes
+        # these and never materializes full logits
+        return x
     logits = x @ params["lm_head"]
     # Logits stay in compute dtype: at 128k vocab an fp32 copy is the
     # single largest buffer in the step. The loss upcasts inside its

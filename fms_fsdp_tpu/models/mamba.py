@@ -208,6 +208,7 @@ def mamba_forward(
     ac_mask: Optional[List[bool]] = None,
     scan_layers: bool = False,  # heterogeneous layers: always unrolled
     mesh: Optional[Mesh] = None,
+    return_hidden: bool = False,
 ):
     """tokens (B, S) int32 -> logits (B, S, padded_vocab) in compute dtype."""
     del scan_layers
@@ -244,6 +245,8 @@ def mamba_forward(
         residual = fn(residual, layer)
 
     x = rms_norm(residual.astype(compute_dtype), params["norm_f"], cfg.norm_eps)
+    if return_hidden:
+        return x
     logits = x @ params["lm_head"]
     return _constrain(logits, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
 

@@ -1,0 +1,115 @@
+"""Fused lm-head + cross-entropy with chunked logits.
+
+At 128k vocab the (B, S, V) logits tensor and its gradient are the two
+largest buffers in a training step (the reference pays the same cost via
+``CrossEntropyLoss`` over full logits, ref:train_utils.py:88-93 — it even
+``del output`` to claw the memory back). This op never materializes them:
+
+- forward: scan over token chunks; each chunk computes its logits tile,
+  fp32 logsumexp and gold score, and drops the tile;
+- backward: recompute each chunk's logits tile and form
+  (softmax - onehot) * g on the fly, producing dx and accumulating dW in
+  fp32.
+
+The trade is one extra lm-head matmul (the recompute) for O(B*S*V)
+memory — the standard fused-CE trade — which converts directly into
+larger batches or less remat.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+IGNORE_INDEX = -100
+
+
+def _chunk_fwd(x_c, w, labels_c):
+    """x_c (C, D), w (D, V), labels (C,) -> (sum_loss, n_valid)."""
+    logits = jnp.einsum(
+        "cd,dv->cv", x_c, w, preferred_element_type=jnp.float32
+    )
+    mask = labels_c != IGNORE_INDEX
+    safe = jnp.where(mask, labels_c, 0)
+    m = jnp.max(logits, axis=-1)
+    lse = jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1)) + m
+    gold = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+    return jnp.sum((lse - gold) * mask), jnp.sum(mask)
+
+
+def _chunk_bwd(x_c, w, labels_c, scale):
+    """Recompute the tile and return (dx_c, dw_c) for d(loss_sum) = scale."""
+    logits = jnp.einsum(
+        "cd,dv->cv", x_c, w, preferred_element_type=jnp.float32
+    )
+    mask = labels_c != IGNORE_INDEX
+    safe = jnp.where(mask, labels_c, 0)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(safe, w.shape[1], dtype=jnp.float32)
+    d_logits = (p - onehot) * (mask[:, None] * scale)
+    d_logits = d_logits.astype(x_c.dtype)
+    dx = jnp.einsum("cv,dv->cd", d_logits, w)
+    dw = jnp.einsum(
+        "cd,cv->dv", x_c, d_logits, preferred_element_type=jnp.float32
+    )
+    return dx, dw
+
+
+def _pad_chunks(x, labels, chunk):
+    n, d = x.shape
+    k = -(-n // chunk)
+    pad = k * chunk - n
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        labels = jnp.pad(labels, (0, pad), constant_values=IGNORE_INDEX)
+    return x.reshape(k, chunk, d), labels.reshape(k, chunk)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_linear_cross_entropy(x, w, labels, chunk: int = 4096):
+    """x (B, S, D) in compute dtype, w (D, V), labels (B, S) int with -100
+    ignored -> scalar mean CE over valid tokens (fp32)."""
+    loss, _ = _fused_fwd_impl(x, w, labels, chunk)
+    return loss
+
+
+def _fused_fwd_impl(x, w, labels, chunk):
+    b, s, d = x.shape
+    xc, lc = _pad_chunks(x.reshape(b * s, d), labels.reshape(b * s), chunk)
+
+    def body(carry, inp):
+        tot, n = carry
+        x_c, l_c = inp
+        sl, nv = _chunk_fwd(x_c, w, l_c)
+        return (tot + sl, n + nv), None
+
+    (total, n_valid), _ = lax.scan(body, (jnp.float32(0), jnp.int32(0)), (xc, lc))
+    loss = total / jnp.maximum(n_valid, 1)
+    return loss, n_valid
+
+
+def _fused_fwd(x, w, labels, chunk):
+    loss, n_valid = _fused_fwd_impl(x, w, labels, chunk)
+    return loss, (x, w, labels, n_valid)
+
+
+def _fused_bwd(chunk, res, g):
+    x, w, labels, n_valid = res
+    b, s, d = x.shape
+    xc, lc = _pad_chunks(x.reshape(b * s, d), labels.reshape(b * s), chunk)
+    scale = g / jnp.maximum(n_valid, 1).astype(jnp.float32)
+
+    def body(dw_acc, inp):
+        x_c, l_c = inp
+        dx_c, dw_c = _chunk_bwd(x_c, w, l_c, scale)
+        return dw_acc + dw_c, dx_c
+
+    dw, dx_chunks = lax.scan(body, jnp.zeros(w.shape, jnp.float32), (xc, lc))
+    dx = dx_chunks.reshape(-1, d)[: b * s].reshape(b, s, d)
+    return dx, dw.astype(w.dtype), None
+
+
+fused_linear_cross_entropy.defvjp(_fused_fwd, _fused_bwd)

@@ -21,7 +21,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_TENSOR, DATA_AXES
@@ -59,7 +62,7 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
     scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
     cp = mesh.shape[AXIS_CONTEXT]
     assert q.shape[1] % cp == 0, (
-        f"sequence length {q.shape[1]} must divide the context axis ({cp})"
+        f"context axis size ({cp}) must divide sequence length {q.shape[1]}"
     )
     from fms_fsdp_tpu.parallel.sharding import resolve_spec
 
@@ -82,7 +85,7 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
         mesh=mesh,
         in_specs=(spec_q, spec_kv, spec_kv),
         out_specs=spec_q,
-        check_rep=False,
+        check_vma=False,
     )
     def inner(q, k, v):
         idx = lax.axis_index(AXIS_CONTEXT)

@@ -167,8 +167,11 @@ def make_train_step(
         ac_mask = selective_ac_mask(n_layers, cfg.selective_checkpointing)
     schedule = get_lr_schedule(cfg, start_step)
 
+    fused = cfg.fused_loss
+    chunk = cfg.loss_chunk_size
+
     def loss_fn(params, inputs, labels):
-        logits = forward_fn(
+        out = forward_fn(
             params,
             inputs,
             model_cfg,
@@ -177,8 +180,14 @@ def make_train_step(
             ac_mask=ac_mask,
             scan_layers=cfg.scan_layers,
             mesh=mesh,
+            return_hidden=fused,
         )
-        return cross_entropy_loss(logits, labels)
+        if fused:
+            from fms_fsdp_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+            w = params["lm_head"].astype(policy.compute_dtype)
+            return fused_linear_cross_entropy(out, w, labels, chunk)
+        return cross_entropy_loss(out, labels)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, batch):

@@ -36,6 +36,58 @@ import jax
 from fms_fsdp_tpu.utils.ckpt_paths import get_latest, get_oldest
 
 
+def load_params_only(load_path: str, init_params_fn):
+    """Load just the model params from a training checkpoint (converter
+    path): a params pickle, a step_N_ckp dir, or a checkpoints/ folder.
+
+    Optimizer moments and counters are skipped at the IO layer (orbax
+    placeholder leaves), so conversion reads ~1/3 of the checkpoint bytes
+    and never materializes Adam state. ``init_params_fn(key) -> params``
+    supplies the target structure.
+    """
+    import pickle
+
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+
+    from fms_fsdp_tpu.config import TrainConfig
+    from fms_fsdp_tpu.utils.ckpt_paths import get_latest
+
+    if os.path.isfile(load_path):
+        with open(load_path, "rb") as f:
+            payload = pickle.load(f)
+        return payload.get("model_state", payload)
+
+    # full saved-state structure, with non-param leaves as placeholders
+    from fms_fsdp_tpu.train.step import make_optimizer
+
+    optimizer = make_optimizer(TrainConfig())
+
+    def init_fn(k):
+        params = init_params_fn(k)
+        return {
+            "params": params,
+            "opt_state": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    target = {
+        "params": shapes["params"],
+        "opt_state": jax.tree.map(lambda _: ocp.PLACEHOLDER, shapes["opt_state"]),
+        "step": ocp.PLACEHOLDER,
+    }
+    state_dir = os.path.join(load_path, "state")
+    if not os.path.isdir(state_dir):
+        latest = get_latest(load_path)
+        assert latest is not None, f"no checkpoint under {load_path}"
+        state_dir = os.path.join(latest, "state")
+    restored = ocp.PyTreeCheckpointer().restore(
+        state_dir, args=ocp.args.PyTreeRestore(item=target)
+    )
+    return restored["params"]
+
+
 def _merge_trees(target, loaded, strict: bool):
     """Overlay ``loaded`` onto ``target``. strict=True requires identical
     structure; strict=False takes matching keys and keeps target leaves for

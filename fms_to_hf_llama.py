@@ -94,48 +94,11 @@ def convert_to_hf(params, cfg: LlamaConfig):
 
 
 def load_params(load_path: str, cfg: LlamaConfig):
-    """Load params from an orbax checkpoint dir (step_N_ckp or its parent)
-    or a single-file pickle."""
-    import pickle
-
-    import jax
-
-    if os.path.isfile(load_path):
-        with open(load_path, "rb") as f:
-            payload = pickle.load(f)
-        return payload.get("model_state", payload)
-
-    import orbax.checkpoint as ocp
-
-    from fms_fsdp_tpu.config import TrainConfig
+    """Load params (only) from a checkpoint dir or single-file pickle."""
     from fms_fsdp_tpu.models.llama import init_llama_params
-    from fms_fsdp_tpu.train.step import make_optimizer
+    from fms_fsdp_tpu.utils.checkpointing import load_params_only
 
-    # full state structure (params + optimizer) mirrors what training saved
-    optimizer = make_optimizer(TrainConfig())
-
-    def init_fn(k):
-        import jax.numpy as jnp
-
-        params = init_llama_params(k, cfg)
-        return {
-            "params": params,
-            "opt_state": optimizer.init(params),
-            "step": jnp.zeros((), jnp.int32),
-        }
-
-    target = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
-
-    state_dir = os.path.join(load_path, "state")
-    if not os.path.isdir(state_dir):
-        # maybe a checkpoints/ folder: pick the newest step dir
-        from fms_fsdp_tpu.utils.ckpt_paths import get_latest
-
-        latest = get_latest(load_path)
-        assert latest is not None, f"no checkpoint under {load_path}"
-        state_dir = os.path.join(latest, "state")
-    restored = ocp.StandardCheckpointer().restore(state_dir, target)
-    return restored["params"]
+    return load_params_only(load_path, lambda k: init_llama_params(k, cfg))
 
 
 def main(**kwargs):

@@ -102,45 +102,15 @@ def save_pretrained(params, cfg: MambaConfig, save_path: str):
 
 
 def main(**kwargs):
-    import pickle
-
     cfg = get_model_config(kwargs.get("model_variant", "mamba_9.8b"))
     update_config(cfg, **kwargs)
     load_path = kwargs["load_path"]
     save_path = kwargs["save_path"]
 
-    if os.path.isfile(load_path):
-        with open(load_path, "rb") as f:
-            payload = pickle.load(f)
-        params = payload.get("model_state", payload)
-    else:
-        import jax
-        import jax.numpy as jnp
-        import orbax.checkpoint as ocp
+    from fms_fsdp_tpu.models.mamba import init_mamba_params
+    from fms_fsdp_tpu.utils.checkpointing import load_params_only
 
-        from fms_fsdp_tpu.config import TrainConfig
-        from fms_fsdp_tpu.models.mamba import init_mamba_params
-        from fms_fsdp_tpu.train.step import make_optimizer
-        from fms_fsdp_tpu.utils.ckpt_paths import get_latest
-
-        optimizer = make_optimizer(TrainConfig())
-
-        def init_fn(k):
-            params = init_mamba_params(k, cfg)
-            return {
-                "params": params,
-                "opt_state": optimizer.init(params),
-                "step": jnp.zeros((), jnp.int32),
-            }
-
-        target = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
-        state_dir = os.path.join(load_path, "state")
-        if not os.path.isdir(state_dir):
-            latest = get_latest(load_path)
-            assert latest is not None, f"no checkpoint under {load_path}"
-            state_dir = os.path.join(latest, "state")
-        params = ocp.StandardCheckpointer().restore(state_dir, target)["params"]
-
+    params = load_params_only(load_path, lambda k: init_mamba_params(k, cfg))
     save_pretrained(params, cfg, save_path)
     print(f"mamba_ssm-format model saved to {save_path}")
 
