@@ -53,42 +53,50 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k, causal):
 
     if causal:
         num_kb = (q_start + block_q + block_k - 1) // block_k
+        diag_start = q_start // block_k  # first block needing a mask
     else:
         num_kb = seq_k // block_k
+        diag_start = num_kb
 
-    def body(kb, carry):
-        acc, m, l = carry
-        k_start = kb * block_k
-        k = k_ref[0, 0, pl.ds(k_start, block_k), :]
-        v = v_ref[0, 0, pl.ds(k_start, block_k), :]
-        s = (
-            jax.lax.dot_general(
-                q,
-                k,
-                (((1,), (1,)), ((), ())),
+    def make_body(masked):
+        def body(kb, carry):
+            acc, m, l = carry
+            k_start = kb * block_k
+            k = k_ref[0, 0, pl.ds(k_start, block_k), :]
+            v = v_ref[0, 0, pl.ds(k_start, block_k), :]
+            s = (
+                jax.lax.dot_general(
+                    q,
+                    k,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # (BQ, BK) fp32
+            if masked:
+                s = _causal_mask(s, block_q, block_k, q_start, k_start)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype),
+                v,
+                (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            * scale
-        )  # (BQ, BK) fp32
-        if causal:
-            s = _causal_mask(s, block_q, block_k, q_start, k_start)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype),
-            v,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc = acc * alpha + pv
-        return acc, m_new, l
+            acc = acc * alpha + pv
+            return acc, m_new, l
+
+        return body
 
     acc = jnp.zeros((block_q, head), jnp.float32)
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc, m, l))
+    # sub-diagonal blocks skip mask construction entirely (VPU savings);
+    # only the diagonal span pays for position math
+    carry = jax.lax.fori_loop(0, diag_start, make_body(False), (acc, m, l))
+    acc, m, l = jax.lax.fori_loop(diag_start, num_kb, make_body(True), carry)
 
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0, 0] = m + jnp.log(l)
@@ -154,32 +162,38 @@ def _dq_kernel(
 
     if causal:
         num_kb = (q_start + block_q + block_k - 1) // block_k
+        diag_start = q_start // block_k
     else:
         num_kb = seq_k // block_k
+        diag_start = num_kb
 
-    def body(kb, dq):
-        k_start = kb * block_k
-        k = k_ref[0, 0, pl.ds(k_start, block_k), :]
-        v = v_ref[0, 0, pl.ds(k_start, block_k), :]
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    def make_body(masked):
+        def body(kb, dq):
+            k_start = kb * block_k
+            k = k_ref[0, 0, pl.ds(k_start, block_k), :]
+            v = v_ref[0, 0, pl.ds(k_start, block_k), :]
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )
+                * scale
             )
-            * scale
-        )
-        if causal:
-            s = _causal_mask(s, block_q, block_k, q_start, k_start)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = (p * (dp - delta) * scale).astype(k.dtype)
-        return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
+            if masked:
+                s = _causal_mask(s, block_q, block_k, q_start, k_start)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            ds = (p * (dp - delta) * scale).astype(k.dtype)
+            return dq + jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+
+        return body
 
     dq = jnp.zeros((block_q, head), jnp.float32)
-    dq = jax.lax.fori_loop(0, num_kb, body, dq)
+    dq = jax.lax.fori_loop(0, diag_start, make_body(False), dq)
+    dq = jax.lax.fori_loop(diag_start, num_kb, make_body(True), dq)
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
@@ -212,43 +226,55 @@ def _dkv_kernel(
     k = k_ref[0, 0]
     v = v_ref[0, 0]
 
-    qb_start = (k_start // block_q) if causal else 0
     num_qb = seq_q // block_q
+    if causal:
+        qb_start = k_start // block_q
+        # q blocks overlapping [k_start, k_start + block_k) need the mask
+        unmasked_start = (k_start + block_k + block_q - 1) // block_q
+    else:
+        qb_start = 0
+        unmasked_start = 0
 
-    def body(qb, carry):
-        dk, dv = carry
-        q_start = qb * block_q
-        q = q_ref[0, 0, pl.ds(q_start, block_q), :]
-        do = do_ref[0, 0, pl.ds(q_start, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(q_start, block_q), :]
-        delta = delta_ref[0, 0, pl.ds(q_start, block_q), :]
-        s = (
-            jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    def make_body(masked):
+        def body(qb, carry):
+            dk, dv = carry
+            q_start = qb * block_q
+            q = q_ref[0, 0, pl.ds(q_start, block_q), :]
+            do = do_ref[0, 0, pl.ds(q_start, block_q), :]
+            lse = lse_ref[0, 0, pl.ds(q_start, block_q), :]
+            delta = delta_ref[0, 0, pl.ds(q_start, block_q), :]
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )
+                * scale
             )
-            * scale
-        )
-        if causal:
-            s = _causal_mask(s, block_q, block_k, q_start, k_start)
-        p = jnp.exp(s - lse)  # (BQ, BK) fp32
-        dv = dv + jax.lax.dot_general(
-            p.astype(do.dtype),
-            do,
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
-        dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return dk, dv
+            if masked:
+                s = _causal_mask(s, block_q, block_k, q_start, k_start)
+            p = jnp.exp(s - lse)  # (BQ, BK) fp32
+            dv = dv + jax.lax.dot_general(
+                p.astype(do.dtype),
+                do,
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            ds = (p * (dp - delta) * scale).astype(q.dtype)
+            dk = dk + jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            return dk, dv
+
+        return body
 
     dk = jnp.zeros((block_k, head), jnp.float32)
     dv = jnp.zeros((block_k, head), jnp.float32)
-    dk, dv = jax.lax.fori_loop(qb_start, num_qb, body, (dk, dv))
+    carry = jax.lax.fori_loop(
+        qb_start, jnp.minimum(unmasked_start, num_qb), make_body(True), (dk, dv)
+    )
+    dk, dv = jax.lax.fori_loop(unmasked_start, num_qb, make_body(False), carry)
 
     # accumulate across the GQA group: grid's last dim (g) revisits the same
     # output block sequentially

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# Canonical ignored-label sentinel (torch CrossEntropyLoss default);
+# train.step re-exports it for the unfused path.
 IGNORE_INDEX = -100
 
 

@@ -21,10 +21,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
+from jax import shard_map  # jax >= 0.8 API (check_vma kwarg)
 from jax.sharding import PartitionSpec as P
 
 from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_TENSOR, DATA_AXES

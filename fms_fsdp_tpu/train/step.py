@@ -29,7 +29,9 @@ from fms_fsdp_tpu.parallel.sharding import (
     tree_shardings,
 )
 
-IGNORE_INDEX = -100  # torch CrossEntropyLoss default (ref:train_utils.py:90-91)
+# torch CrossEntropyLoss default (ref:train_utils.py:90-91); one definition
+# shared with the fused loss path
+from fms_fsdp_tpu.ops.fused_ce import IGNORE_INDEX
 
 
 def cross_entropy_loss(logits, labels):

@@ -51,7 +51,6 @@ def load_params_only(load_path: str, init_params_fn):
     import orbax.checkpoint as ocp
 
     from fms_fsdp_tpu.config import TrainConfig
-    from fms_fsdp_tpu.utils.ckpt_paths import get_latest
 
     if os.path.isfile(load_path):
         with open(load_path, "rb") as f:
