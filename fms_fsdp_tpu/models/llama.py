@@ -30,6 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from fms_fsdp_tpu.models.configs import LlamaConfig
 from fms_fsdp_tpu.ops.attention import attention
 from fms_fsdp_tpu.ops.norms import rms_norm
+from fms_fsdp_tpu.ops.quant import matmul as qmatmul
 from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
 from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_TENSOR, DATA_AXES
 
@@ -110,6 +111,7 @@ def _llama_block(
     *,
     attn_impl: str,
     mesh: Optional[Mesh],
+    quant: str = "none",
 ):
     """One decoder block: x + Attn(RMS(x)); then x + SwiGLU(RMS(x))."""
     b, s, d = x.shape
@@ -122,9 +124,9 @@ def _llama_block(
     # llama_forward entry — that placement is what makes GSPMD all-gather
     # bf16 bytes).
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = (h @ layer["wq"]).reshape(b, s, nq, hd)
-    k = (h @ layer["wk"]).reshape(b, s, nkv, hd)
-    v = (h @ layer["wv"]).reshape(b, s, nkv, hd)
+    q = qmatmul(h, layer["wq"], quant=quant).reshape(b, s, nq, hd)
+    k = qmatmul(h, layer["wk"], quant=quant).reshape(b, s, nkv, hd)
+    v = qmatmul(h, layer["wv"], quant=quant).reshape(b, s, nkv, hd)
     q = _constrain(q, head_spec, mesh)
     k = _constrain(k, head_spec, mesh)
     q = apply_rotary(q, cos, sin)
@@ -137,14 +139,14 @@ def _llama_block(
         o = ring_attention(q, k, v, mesh, causal=True)
     else:
         o = attention(q, k, v, causal=True, impl=attn_impl)
-    o = o.reshape(b, s, nq * hd) @ layer["wo"]
+    o = qmatmul(o.reshape(b, s, nq * hd), layer["wo"], quant=quant)
     x = x + _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 
     h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ layer["w1"])
-    up = h @ layer["w3"]
+    gate = jax.nn.silu(qmatmul(h, layer["w1"], quant=quant))
+    up = qmatmul(h, layer["w3"], quant=quant)
     ffn = _constrain(gate * up, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
-    ffn = ffn @ layer["w2"]
+    ffn = qmatmul(ffn, layer["w2"], quant=quant)
     return x + _constrain(ffn, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 
 
@@ -160,6 +162,7 @@ def llama_forward(
     mesh: Optional[Mesh] = None,
     return_embeds: bool = False,
     return_hidden: bool = False,
+    quant: str = "none",
 ):
     """tokens (B, S) int32 -> logits (B, S, V) in the compute dtype.
 
@@ -180,7 +183,13 @@ def llama_forward(
     cos, sin = rope_table(seq_len, cfg.head_dim, cfg.rope_theta)
 
     block = functools.partial(
-        _llama_block, cfg=cfg, cos=cos, sin=sin, attn_impl=attn_impl, mesh=mesh
+        _llama_block,
+        cfg=cfg,
+        cos=cos,
+        sin=sin,
+        attn_impl=attn_impl,
+        mesh=mesh,
+        quant=quant,
     )
     ac_mask = ac_mask if ac_mask is not None else [False] * nlayers
     uniform = all(ac_mask) or not any(ac_mask)

@@ -209,9 +209,15 @@ def mamba_forward(
     scan_layers: bool = False,  # heterogeneous layers: always unrolled
     mesh: Optional[Mesh] = None,
     return_hidden: bool = False,
+    quant: str = "none",
 ):
     """tokens (B, S) int32 -> logits (B, S, padded_vocab) in compute dtype."""
     del scan_layers
+    if quant != "none":
+        raise ValueError(
+            "quantized_matmuls is Llama-only for now; got "
+            f"{quant!r} on a Mamba config"
+        )
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     n_layer = len(params["layers"])
     ac_mask = ac_mask if ac_mask is not None else [False] * n_layer

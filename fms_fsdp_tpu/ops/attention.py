@@ -39,7 +39,9 @@ def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None
     )
     if causal:
         sk = k.shape[1]
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        # top-left alignment for sq != sk (query i attends keys <= i),
+        # matching both the Pallas kernel and torch SDPA is_causal
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
         scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bkgqs,bskh->bqkgh", probs, v)

@@ -8,9 +8,11 @@ Replaces the reference's SDPA FlashAttention-2 CUDA path
   the FlashAttention-2 online softmax (fp32 running max/denominator), so
   HBM traffic is O(S) and the (S, S) score matrix never materializes;
 - backward: a dq kernel mirroring the forward walk, and a dk/dv kernel
-  gridded per kv-block that re-walks q-blocks above the diagonal and
-  accumulates across the GQA group by output-block revisiting (TPU grids
-  execute sequentially, so revisited output blocks accumulate safely);
+  gridded (b, kv-head, k-block, gqa-member, q-block) that streams q
+  through the grid, accumulates dk/dv in fp32 VMEM scratch across the
+  (gqa-member, q-block) sweep, and computes scores transposed (BK, BQ)
+  so softmax stats broadcast from row-layout (B, N, 1, S) lse/delta —
+  column layout would lane-pad each stat element x128 in VMEM;
 - GQA native: kv heads are indexed via block-spec index maps
   (kv_head = q_head // group) — kv is never materialized repeated
   (70B trains at 64 q / 8 kv heads, ref:config_utils.py:26-34).
@@ -211,82 +213,105 @@ def _dkv_kernel(
     delta_ref,
     dk_ref,
     dv_ref,
+    dk_acc,
+    dv_acc,
     *,
     scale,
-    block_q,
     causal,
+    group,
+    num_qb,
 ):
+    """Streamed-q dk/dv: grid (b, kvh, ki, g, qi), q walked via the grid.
+
+    The kv block stays resident across the whole (g, qi) sweep; dk/dv
+    accumulate in fp32 VMEM scratch across both the q walk and the GQA
+    group, and are written once at the final (g, qi) step. Scores are
+    computed transposed — (BK, BQ) — so the softmax stats broadcast from
+    row-layout lse/delta (B, N, 1, S): a (S, 1) column layout would pad
+    each element to a full 128-lane vector in VMEM.
+    """
     block_k = k_ref.shape[2]
-    head = k_ref.shape[3]
-    seq_q = q_ref.shape[2]
+    block_q = q_ref.shape[2]
     ki = pl.program_id(2)
     g = pl.program_id(3)
+    qi = pl.program_id(4)
     k_start = ki * block_k
 
-    k = k_ref[0, 0]
-    v = v_ref[0, 0]
-
-    num_qb = seq_q // block_q
     if causal:
-        qb_start = k_start // block_q
-        # q blocks overlapping [k_start, k_start + block_k) need the mask
-        unmasked_start = (k_start + block_k + block_q - 1) // block_q
+        qi0 = (ki * block_k) // block_q  # first q block on/under the diagonal
+        run = qi >= qi0
     else:
-        qb_start = 0
-        unmasked_start = 0
+        qi0 = 0
+        run = True
 
-    def make_body(masked):
-        def body(qb, carry):
-            dk, dv = carry
-            q_start = qb * block_q
-            q = q_ref[0, 0, pl.ds(q_start, block_q), :]
-            do = do_ref[0, 0, pl.ds(q_start, block_q), :]
-            lse = lse_ref[0, 0, pl.ds(q_start, block_q), :]
-            delta = delta_ref[0, 0, pl.ds(q_start, block_q), :]
-            s = (
-                jax.lax.dot_general(
-                    q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-                )
-                * scale
-            )
-            if masked:
-                s = _causal_mask(s, block_q, block_k, q_start, k_start)
-            p = jnp.exp(s - lse)  # (BQ, BK) fp32
-            dv = dv + jax.lax.dot_general(
-                p.astype(do.dtype),
-                do,
-                (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            dp = jax.lax.dot_general(
-                do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            ds = (p * (dp - delta) * scale).astype(q.dtype)
-            dk = dk + jax.lax.dot_general(
-                ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            return dk, dv
-
-        return body
-
-    dk = jnp.zeros((block_k, head), jnp.float32)
-    dv = jnp.zeros((block_k, head), jnp.float32)
-    carry = jax.lax.fori_loop(
-        qb_start, jnp.minimum(unmasked_start, num_qb), make_body(True), (dk, dv)
-    )
-    dk, dv = jax.lax.fori_loop(unmasked_start, num_qb, make_body(False), carry)
-
-    # accumulate across the GQA group: grid's last dim (g) revisits the same
-    # output block sequentially
-    @pl.when(g == 0)
+    # Zero-init at the first *visited* cell (not the first contributing
+    # one): a k-block entirely past the q sequence (causal cross-length)
+    # never contributes, and its write-out below must emit zeros, not
+    # whatever the previous k-block left in scratch.
+    @pl.when((g == 0) & (qi == 0))
     def _():
-        dk_ref[0, 0] = dk
-        dv_ref[0, 0] = dv
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    @pl.when(g > 0)
+    def contribution(masked, q_start):
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0]  # (1, BQ) rows
+        delta = delta_ref[0, 0]
+        st = (
+            jax.lax.dot_general(
+                k, q, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            * scale
+        )  # (BK, BQ)
+        if masked:
+            kpos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0
+            )
+            qpos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1
+            )
+            st = jnp.where(qpos >= kpos, st, NEG_INF)
+        pt = jnp.exp(st - lse)  # (BK, BQ)
+        dv_acc[...] += jax.lax.dot_general(
+            pt.astype(do.dtype),
+            do,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BK, BQ)
+        dst = (pt * (dpt - delta) * scale).astype(q.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            dst, q, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        q_start = qi * block_q
+        # blocks straddling the diagonal need the element mask
+        is_diag = q_start < k_start + block_k - 1
+
+        @pl.when(run & is_diag)
+        def _():
+            contribution(True, q_start)
+
+        @pl.when(run & jnp.logical_not(is_diag))
+        def _():
+            contribution(False, q_start)
+
+    else:
+
+        @pl.when(run)
+        def _():
+            contribution(False, qi * block_q)
+
+    @pl.when((g == group - 1) & (qi == num_qb - 1))
     def _():
-        dk_ref[0, 0] += dk
-        dv_ref[0, 0] += dv
+        dk_ref[0, 0] = dk_acc[...]
+        dv_ref[0, 0] = dv_acc[...]
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout):
@@ -315,39 +340,65 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout):
         interpret=interpret,
     )(q, k, v, dout, lse, delta)
 
+    # row-layout stats for the transposed dk/dv kernel: (B, N, 1, S)
+    lse_rows = jnp.swapaxes(lse, 2, 3)
+    delta_rows = jnp.swapaxes(delta, 2, 3)
+    num_qb = seq_q // block_q
+    num_kb = seq_k // block_k
+
+    def qmap(b, kvh, ki, g, qi):
+        # clamp skipped (above-diagonal) cells onto the first contributing
+        # q block so no extra DMA is issued for them
+        if causal:
+            qi = jnp.maximum(qi, (ki * block_k) // block_q)
+        return (b, kvh * group + g, qi, 0)
+
+    def qmap_rows(b, kvh, ki, g, qi):
+        if causal:
+            qi = jnp.maximum(qi, (ki * block_k) // block_q)
+        return (b, kvh * group + g, 0, qi)
+
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, block_q=block_q, causal=causal),
-        grid=(batch, nkv, seq_k // block_k, group),
+        functools.partial(
+            _dkv_kernel,
+            scale=scale,
+            causal=causal,
+            group=group,
+            num_qb=num_qb,
+        ),
+        grid=(batch, nkv, num_kb, group, num_qb),
         in_specs=[
+            pl.BlockSpec((1, 1, block_q, head), qmap),
             pl.BlockSpec(
-                (1, 1, seq_q, head),
-                lambda b, kvh, i, g: (b, kvh * group + g, 0, 0),
-            ),
-            pl.BlockSpec((1, 1, block_k, head), lambda b, kvh, i, g: (b, kvh, i, 0)),
-            pl.BlockSpec((1, 1, block_k, head), lambda b, kvh, i, g: (b, kvh, i, 0)),
-            pl.BlockSpec(
-                (1, 1, seq_q, head),
-                lambda b, kvh, i, g: (b, kvh * group + g, 0, 0),
+                (1, 1, block_k, head), lambda b, kvh, ki, g, qi: (b, kvh, ki, 0)
             ),
             pl.BlockSpec(
-                (1, 1, seq_q, 1), lambda b, kvh, i, g: (b, kvh * group + g, 0, 0)
+                (1, 1, block_k, head), lambda b, kvh, ki, g, qi: (b, kvh, ki, 0)
             ),
-            pl.BlockSpec(
-                (1, 1, seq_q, 1), lambda b, kvh, i, g: (b, kvh * group + g, 0, 0)
-            ),
+            pl.BlockSpec((1, 1, block_q, head), qmap),
+            pl.BlockSpec((1, 1, 1, block_q), qmap_rows),
+            pl.BlockSpec((1, 1, 1, block_q), qmap_rows),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, head), lambda b, kvh, i, g: (b, kvh, i, 0)),
-            pl.BlockSpec((1, 1, block_k, head), lambda b, kvh, i, g: (b, kvh, i, 0)),
+            pl.BlockSpec(
+                (1, 1, block_k, head), lambda b, kvh, ki, g, qi: (b, kvh, ki, 0)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k, head), lambda b, kvh, ki, g, qi: (b, kvh, ki, 0)
+            ),
         ],
-        # fp32 outputs: the cross-group revisit accumulation must not round
-        # to bf16 between group members (llama2_70b accumulates 8 of them)
+        # fp32 outputs: dk/dv accumulate in fp32 scratch; keep the store
+        # dtype fp32 so GQA-group sums don't round between members
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, jnp.float32),
             jax.ShapeDtypeStruct(v.shape, jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head), jnp.float32),
+            pltpu.VMEM((block_k, head), jnp.float32),
+        ],
         interpret=interpret,
-    )(q, k, v, dout, lse, delta)
+    )(q, k, v, dout, lse_rows, delta_rows)
 
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 

@@ -1,0 +1,110 @@
+"""int8 quantized matmuls for the forward pass (v5e/v5p MXU int8 path).
+
+The reference trains pure-bf16 GEMMs (ref:policies/mixed_precision.py) —
+on A100 that is the right call. TPU v5e's MXU runs int8 at ~2x its bf16
+rate (394 vs 197 peak TOPS; ~254 vs ~150 sustained on 8k matmuls here),
+so this module implements the standard dynamic-quantization recipe (AQT
+style) to buy that factor for the forward pass:
+
+- activations: per-row (per-token) absmax scale to int8;
+- weights: per-column (per-output-channel) absmax scale to int8;
+- int8 x int8 -> int32 accumulation on the MXU, dequantized by the outer
+  product of the two scale vectors (rank-1 — exact, cheap, fuses);
+- backward: straight-through to the bf16 operands (dx = g @ W^T,
+  dW = x^T @ g computed in bf16), so gradients are exactly those of the
+  unquantized matmul evaluated at the same operands.
+
+The quantization overhead is a few elementwise passes per GEMM — O(T*D +
+D*F + T*F) VPU work against O(T*D*F) MXU work — negligible at training
+shapes. Enabled via ``TrainConfig.quantized_matmuls = "int8"``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _absmax_quant(x, axis):
+    """Symmetric int8 quantization along ``axis`` (the contraction dim).
+
+    Returns (q_int8, scale) with x ~= q * scale, scale shaped like x with
+    ``axis`` reduced (kept as 1 for broadcasting).
+    """
+    amax = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
+    scale = (amax / 127.0).astype(jnp.float32)
+    safe = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / safe), -127, 127
+    ).astype(jnp.int8)
+    return q, jnp.where(scale == 0, 0.0, scale)
+
+
+def int8_matmul_raw(x, w):
+    """x (..., T, D) @ w (D, F) via int8 MXU with dynamic dequant."""
+    qx, sx = _absmax_quant(x, axis=-1)  # sx (..., T, 1)
+    qw, sw = _absmax_quant(w, axis=0)  # sw (1, F)
+    acc = jax.lax.dot_general(
+        qx,
+        qw,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc.astype(jnp.float32) * sx * sw).astype(x.dtype)
+
+
+def _dgrad(g, w, quantized: bool):
+    """dx = g @ w^T, optionally on the int8 path (per-row g scale,
+    per-row w scale — both contract over the F dim)."""
+    if not quantized:
+        return jax.lax.dot_general(g, w, (((g.ndim - 1,), (1,)), ((), ())))
+    qg, sg = _absmax_quant(g, axis=-1)  # (..., T, 1)
+    qw, sw = _absmax_quant(w, axis=1)  # (D, 1)
+    acc = jax.lax.dot_general(
+        qg, qw, (((g.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    return acc.astype(jnp.float32) * sg * jnp.squeeze(sw, -1)
+
+
+def _wgrad(x, g):
+    # dW contracts over all leading (token) dims of x/g. Stays bf16: the
+    # weight-gradient accumulates over every token — int8 noise there
+    # biases the update, while dgrad noise washes out like activation noise.
+    lead = tuple(range(g.ndim - 1))
+    return jax.lax.dot_general(x, g, ((lead, lead), ((), ())))
+
+
+def _make_int8_matmul(dgrad_int8: bool):
+    @jax.custom_vjp
+    def f(x, w):
+        return int8_matmul_raw(x, w)
+
+    def fwd(x, w):
+        return int8_matmul_raw(x, w), (x, w)
+
+    def bwd(res, g):
+        x, w = res
+        dx = _dgrad(g, w, dgrad_int8)
+        dw = _wgrad(x, g)
+        return dx.astype(x.dtype), dw.astype(w.dtype)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+int8_matmul = _make_int8_matmul(dgrad_int8=False)
+int8_matmul_dgrad = _make_int8_matmul(dgrad_int8=True)
+
+
+def matmul(x, w, *, quant: str = "none"):
+    """Dispatch: the model's linear layers route through here.
+
+    - "none":       bf16 GEMMs (reference behavior)
+    - "int8":       int8 forward, bf16 backward
+    - "int8_dgrad": int8 forward + int8 dx (wgrad stays bf16)
+    """
+    if quant == "int8":
+        return int8_matmul(x, w)
+    if quant == "int8_dgrad":
+        return int8_matmul_dgrad(x, w)
+    return x @ w

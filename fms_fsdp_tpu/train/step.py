@@ -99,12 +99,31 @@ def make_optimizer(cfg, start_step: int = 0):
     (ref:main_training_llama.py:130-148).
     """
     del start_step
-    return optax.inject_hyperparams(optax.adamw)(
+    return optax.inject_hyperparams(_adamw_fp32_grads)(
         learning_rate=cfg.learning_rate,
         b1=0.9,
         b2=0.95,
         weight_decay=0.1,
     )
+
+
+def _adamw_fp32_grads(learning_rate, b1, b2, weight_decay):
+    """adamw that upcasts incoming (bf16) grads to fp32 per-leaf inside
+    ``update``. Doing the cast here rather than as a whole-tree map before
+    the optimizer keeps each fp32 buffer leaf-local — the all-live gradient
+    set stays in the reduce dtype, which is what lets 7B-shaped layers
+    train on a 16GB chip. Reusing adamw's own ``init`` keeps the opt_state
+    pytree (and therefore the checkpoint format) identical to plain adamw.
+    """
+    inner = optax.adamw(
+        learning_rate=learning_rate, b1=b1, b2=b2, weight_decay=weight_decay
+    )
+
+    def update(grads, state, params=None):
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        return inner.update(grads, state, params)
+
+    return optax.GradientTransformation(inner.init, update)
 
 
 def init_train_state(
@@ -183,6 +202,7 @@ def make_train_step(
             scan_layers=cfg.scan_layers,
             mesh=mesh,
             return_hidden=fused,
+            quant=cfg.quantized_matmuls,
         )
         if fused:
             from fms_fsdp_tpu.ops.fused_ce import fused_linear_cross_entropy
@@ -199,10 +219,16 @@ def make_train_step(
         )
         inputs = jax.lax.with_sharding_constraint(inputs, bspec)
         labels = jax.lax.with_sharding_constraint(labels, bspec)
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"], inputs, labels)
-        # Keep optimizer math in the storage dtype (fp32 master for the
-        # bfSixteen policy); no-op when grads already match.
-        grads = jax.tree.map(lambda g: g.astype(policy.param_dtype), grads)
+        # Differentiate w.r.t. a compute-dtype copy of the params: gradients
+        # then live in the policy's reduce dtype end-to-end (bf16 for the
+        # bfSixteen preset, mirroring the reference's reduce_dtype=bf16,
+        # ref:policies/mixed_precision.py:5-27) and the all-live grad tree
+        # is half the size of fp32 grads. The fp32 upcast for Adam happens
+        # per-leaf inside the optimizer chain.
+        params_c = jax.tree.map(
+            lambda p: p.astype(policy.compute_dtype), state["params"]
+        )
+        loss, grads = jax.value_and_grad(loss_fn)(params_c, inputs, labels)
         # Global-norm clip with the norm accumulated in fp32 regardless of
         # grad dtype — matches torch clip_grad_norm_ (ref:train_utils.py:96);
         # the pre-clip norm is the value the reference logs.

@@ -1,0 +1,95 @@
+"""Microbench: flash attention TF/s at 7B head shapes on the real chip.
+
+Compares this repo's Pallas kernel against jax's bundled reference
+implementation (jax.experimental.pallas.ops.tpu.flash_attention) to know
+the achievable ceiling. Timing syncs via host transfer (float()) — see
+.claude/skills/verify: block_until_ready does not drain the tunneled queue.
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+B, N, NKV, S, H = 1, 32, 32, 4096, 128
+CAUSAL = True
+
+
+def flops_fwd():
+    f = 2 * 2 * B * N * S * S * H  # qk + pv
+    return f // 2 if CAUSAL else f
+
+
+def time_fn(fn, *args, iters=20):
+    out = fn(*args)
+    _ = float(jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)))  # sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _ = float(jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    kq, kk, kv_ = jax.random.split(jax.random.PRNGKey(0), 3)
+    # repo layout (B, S, N, H)
+    q = jax.random.normal(kq, (B, S, N, H), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, NKV, H), jnp.bfloat16)
+    v = jax.random.normal(kv_, (B, S, NKV, H), jnp.bfloat16)
+
+    from fms_fsdp_tpu.ops.flash_attention import flash_attention
+
+    ours_fwd = jax.jit(functools.partial(flash_attention, causal=CAUSAL))
+
+    def ours_loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=CAUSAL).astype(jnp.float32))
+
+    ours_bwd = jax.jit(jax.grad(ours_loss, argnums=(0, 1, 2)))
+
+    t = time_fn(ours_fwd, q, k, v)
+    print(f"ours fwd: {t*1e3:.2f} ms  {flops_fwd()/t/1e12:.1f} TF/s")
+    t = time_fn(ours_bwd, q, k, v)
+    # fwd (recompute not included: custom vjp saves o, lse) + dq + dkv
+    bwd_flops = flops_fwd() * 3.5 / 1.0  # dq: 3 matmuls? approx: fwd=2mm, bwd=5mm
+    print(f"ours fwd+bwd(grad): {t*1e3:.2f} ms  {flops_fwd()*3.5/t/1e12:.1f} TF/s (counting 3.5x fwd)")
+
+    # jax bundled impl wants (B, N, S, H)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention as jax_fa,
+    )
+
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    bs = BlockSizes(
+        block_q=512, block_k_major=512, block_k=512, block_b=1,
+        block_q_major_dkv=512, block_k_major_dkv=512, block_k_dkv=512,
+        block_q_dkv=512, block_k_major_dq=512, block_k_dq=512, block_q_dq=512,
+    )
+    ref_fwd = jax.jit(
+        functools.partial(jax_fa, causal=CAUSAL, sm_scale=H**-0.5, block_sizes=bs)
+    )
+
+    def ref_loss(q, k, v):
+        return jnp.sum(
+            jax_fa(q, k, v, causal=CAUSAL, sm_scale=H**-0.5, block_sizes=bs).astype(
+                jnp.float32
+            )
+        )
+
+    ref_bwd = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))
+
+    t = time_fn(ref_fwd, qt, kt, vt)
+    print(f"jax  fwd: {t*1e3:.2f} ms  {flops_fwd()/t/1e12:.1f} TF/s")
+    t = time_fn(ref_bwd, qt, kt, vt)
+    print(f"jax  fwd+bwd(grad): {t*1e3:.2f} ms  {flops_fwd()*3.5/t/1e12:.1f} TF/s (counting 3.5x fwd)")
+
+
+if __name__ == "__main__":
+    main()

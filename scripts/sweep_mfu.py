@@ -1,0 +1,50 @@
+"""Sweep single-chip bench configs; print MFU per config.
+
+Thin CLI over bench.run_config (same methodology as the headline bench).
+
+Usage: python scripts/sweep_mfu.py <bs> <selAC> <fused> <chunk> [variant]
+selAC: 0 for off, else fraction (e.g. 0.5); fused: 0/1.
+variant may carry int overrides: "llama2_7b:nlayers=3".
+Env: SWEEP_QUANT=none|int8|int8_dgrad.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import run_config  # noqa: E402
+
+
+def main():
+    bs = int(sys.argv[1])
+    sel = float(sys.argv[2])
+    fused = bool(int(sys.argv[3]))
+    chunk = int(sys.argv[4])
+    variant = sys.argv[5] if len(sys.argv) > 5 else "llama3_194m_4k"
+    overrides = {}
+    if ":" in variant:
+        variant, ov = variant.split(":", 1)
+        for kv in ov.split(","):
+            key, val = kv.split("=")
+            overrides[key] = int(val)
+    quant = os.environ.get("SWEEP_QUANT", "none")
+
+    r = run_config(
+        variant,
+        batch_size=bs,
+        sel_ac=sel,
+        quant=quant,
+        model_overrides=overrides or None,
+        fused_loss=fused,
+        loss_chunk=chunk or 4096,
+    )
+    print(
+        f"RESULT bs={bs} selAC={sel} fused={fused} chunk={chunk} quant={quant}: "
+        f"MFU={r['mfu']:.4f} HFU={r['hfu']:.4f} "
+        f"tok/s/chip={r['tokens_per_sec_per_chip']} step={r['step_time_s']*1e3:.1f}ms"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,29 @@ def test_flash_matches_xla(nq, nkv, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_flash_grads_cross_length_causal():
+    """seq_k > seq_q, causal: k-blocks wholly past the q sequence must get
+    zero dk/dv (regression: stale-scratch write in the streamed-q kernel)."""
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(1, 256, 4, 128)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 512, 4, 128)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 512, 4, 128)), jnp.float32)
+
+    def f_loss(q, k, v):
+        o = flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128, interpret=True
+        )
+        return (o**2).mean()
+
+    def r_loss(q, k, v):
+        return (xla_attention(q, k, v, causal=True) ** 2).mean()
+
+    gf = jax.grad(f_loss, argnums=(1, 2))(q, k, v)
+    gr = jax.grad(r_loss, argnums=(1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
 def test_flash_grads_match_xla():
     q, k, v = _rand_qkv(1, 256, 4, 2, 128)
 
