@@ -104,9 +104,17 @@ def run_config(
     fpt = llama_train_flops_per_token(model_cfg, cfg.seq_length)
     peak = peak_flops_per_chip()
     mfu = tps * fpt / peak
+    # HFU counts the recompute that actually ran: the mask walk rounds the
+    # nominal fraction at small layer counts (e.g. 3 layers at 1/4 -> 1/3)
+    from fms_fsdp_tpu.parallel.ac import selective_ac_mask
+
+    mask = selective_ac_mask(model_cfg.nlayers, sel_ac) if sel_ac > 0 else []
+    ac_actual = (sum(mask) / model_cfg.nlayers) if mask else 0.0
     hfu = (
         tps
-        * llama_train_flops_per_token(model_cfg, cfg.seq_length, ac_fraction=sel_ac)
+        * llama_train_flops_per_token(
+            model_cfg, cfg.seq_length, ac_fraction=ac_actual
+        )
         / peak
     )
     return {

@@ -64,9 +64,10 @@ class TrainConfig:
     # forces remat; a loss for small embedding-heavy models.
     fused_loss: bool = False
     loss_chunk_size: int = 4096  # tokens per fused-loss logits tile
-    # "int8": forward GEMMs on the MXU int8 path (~2x bf16 rate on v5e+),
-    # straight-through bf16 backward — see ops/quant.py. TPU-native win
-    # with no reference counterpart.
+    # "none" | "int8" (fwd GEMMs on the MXU int8 path, ~2x bf16 rate on
+    # v5e+, bf16 backward) | "int8_dgrad" (additionally int8 dx; wgrad
+    # stays bf16) — see ops/quant.py. TPU-native win with no reference
+    # counterpart.
     quantized_matmuls: str = "none"
 
     # training spec (ref:fms_fsdp/config/training.py:37-43)

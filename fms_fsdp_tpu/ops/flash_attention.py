@@ -346,16 +346,23 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout):
     num_qb = seq_q // block_q
     num_kb = seq_k // block_k
 
-    def qmap(b, kvh, ki, g, qi):
+    def _clamp_qi(qi, ki):
         # clamp skipped (above-diagonal) cells onto the first contributing
-        # q block so no extra DMA is issued for them
+        # q block so no extra DMA is issued for them; the upper clamp keeps
+        # the fetch in-bounds for k-blocks wholly past the q sequence
+        # (causal cross-length), where no cell contributes at all
+        return jnp.minimum(
+            jnp.maximum(qi, (ki * block_k) // block_q), num_qb - 1
+        )
+
+    def qmap(b, kvh, ki, g, qi):
         if causal:
-            qi = jnp.maximum(qi, (ki * block_k) // block_q)
+            qi = _clamp_qi(qi, ki)
         return (b, kvh * group + g, qi, 0)
 
     def qmap_rows(b, kvh, ki, g, qi):
         if causal:
-            qi = jnp.maximum(qi, (ki * block_k) // block_q)
+            qi = _clamp_qi(qi, ki)
         return (b, kvh * group + g, 0, qi)
 
     dk, dv = pl.pallas_call(

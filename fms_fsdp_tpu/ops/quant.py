@@ -107,4 +107,6 @@ def matmul(x, w, *, quant: str = "none"):
         return int8_matmul(x, w)
     if quant == "int8_dgrad":
         return int8_matmul_dgrad(x, w)
+    if quant != "none":
+        raise ValueError(f"unknown quantized_matmuls value: {quant!r}")
     return x @ w

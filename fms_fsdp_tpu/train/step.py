@@ -108,19 +108,21 @@ def make_optimizer(cfg, start_step: int = 0):
 
 
 def _adamw_fp32_grads(learning_rate, b1, b2, weight_decay):
-    """adamw that upcasts incoming (bf16) grads to fp32 per-leaf inside
-    ``update``. Doing the cast here rather than as a whole-tree map before
-    the optimizer keeps each fp32 buffer leaf-local — the all-live gradient
-    set stays in the reduce dtype, which is what lets 7B-shaped layers
-    train on a 16GB chip. Reusing adamw's own ``init`` keeps the opt_state
-    pytree (and therefore the checkpoint format) identical to plain adamw.
+    """adamw that upcasts incoming (bf16) grads to the param (storage)
+    dtype per-leaf inside ``update``. Doing the cast here rather than as a
+    whole-tree map before the optimizer keeps each upcast buffer
+    leaf-local — the all-live gradient set stays in the reduce dtype,
+    which is what lets 7B-shaped layers train on a 16GB chip. Casting to
+    the *param* dtype (not unconditionally fp32) keeps moment dtypes
+    stable under the pure_bf16 policy, and reusing adamw's own ``init``
+    keeps the opt_state pytree identical to plain adamw.
     """
     inner = optax.adamw(
         learning_rate=learning_rate, b1=b1, b2=b2, weight_decay=weight_decay
     )
 
-    def update(grads, state, params=None):
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+    def update(grads, state, params):
+        grads = jax.tree.map(lambda g, p: g.astype(p.dtype), grads, params)
         return inner.update(grads, state, params)
 
     return optax.GradientTransformation(inner.init, update)
