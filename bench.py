@@ -50,8 +50,8 @@ def run_config(
     )
     from fms_fsdp_tpu.utils.config_utils import get_model_config
     from fms_fsdp_tpu.utils.flops import (
-        llama_train_flops_per_token,
         peak_flops_per_chip,
+        train_flops_per_token,
     )
 
     n_chips = len(jax.devices())
@@ -76,12 +76,13 @@ def run_config(
     state, _ = init_train_state(jax.random.PRNGKey(0), model_cfg, cfg, mesh, opt)
     step_fn = make_train_step(model_cfg, cfg, mesh, opt)
 
+    vocab = getattr(model_cfg, "src_vocab_size", None) or model_cfg.vocab_size
     global_batch = cfg.batch_size * n_chips
     tokens = jax.random.randint(
         jax.random.PRNGKey(1),
         (global_batch, cfg.seq_length + 1),
         0,
-        model_cfg.src_vocab_size,
+        vocab,
         dtype=jnp.int32,
     )
     batch = (tokens[:, :-1], tokens[:, 1:])
@@ -101,20 +102,19 @@ def run_config(
         best = min(best, (time.perf_counter() - t0) / steps)
 
     tps = global_batch * cfg.seq_length / best / n_chips
-    fpt = llama_train_flops_per_token(model_cfg, cfg.seq_length)
+    fpt = train_flops_per_token(model_cfg, cfg.seq_length)
     peak = peak_flops_per_chip()
     mfu = tps * fpt / peak
     # HFU counts the recompute that actually ran: the mask walk rounds the
     # nominal fraction at small layer counts (e.g. 3 layers at 1/4 -> 1/3)
     from fms_fsdp_tpu.parallel.ac import selective_ac_mask
 
-    mask = selective_ac_mask(model_cfg.nlayers, sel_ac) if sel_ac > 0 else []
-    ac_actual = (sum(mask) / model_cfg.nlayers) if mask else 0.0
+    n_layers = getattr(model_cfg, "nlayers", None) or model_cfg.n_layer
+    mask = selective_ac_mask(n_layers, sel_ac) if sel_ac > 0 else []
+    ac_actual = (sum(mask) / n_layers) if mask else 0.0
     hfu = (
         tps
-        * llama_train_flops_per_token(
-            model_cfg, cfg.seq_length, ac_fraction=ac_actual
-        )
+        * train_flops_per_token(model_cfg, cfg.seq_length, ac_fraction=ac_actual)
         / peak
     )
     return {
@@ -156,6 +156,22 @@ def main():
 
     r = run_config("llama3_194m_4k", batch_size=4, sel_ac=0.5)
     r["config"] = "llama3_194m_4k bs=4 selAC=1/2 bf16 seq=4096"
+    rows.append(r)
+
+    # mamba_9.8b per-layer shapes (d_model 4096 / d_inner 8192 / 128 heads /
+    # d_state 128 / MLP 14336), pure-Mamba layers, vocab cut to 32k so the
+    # train state fits one chip — exercises the chunked SSD scan path
+    r = run_config(
+        "mamba_9.8b",
+        batch_size=2,
+        sel_ac=0.5,
+        model_overrides={
+            "n_layer": 3,
+            "attn_layer_idx": (),
+            "vocab_size": 32000,
+        },
+    )
+    r["config"] = "mamba_9.8b-shaped (L=3, 32k vocab) bs=2 selAC=1/2 bf16 seq=4096"
     rows.append(r)
 
     head = rows[0]

@@ -38,6 +38,54 @@ def llama_train_flops_per_token(
     return fwd * (3 + ac_fraction)
 
 
+def mamba_matmul_params(cfg) -> int:
+    """Matmul-participating params of the hybrid Mamba2 stack (everything
+    but the embedding gather; lm_head counts). Mirrors
+    models/mamba.py:init_mamba_params layer shapes."""
+    d = cfg.d_model
+    ipd = 2 * cfg.d_inner + 2 * cfg.ngroups * cfg.d_state + cfg.nheads
+    a = cfg.attn_cfg
+    total = d * cfg.padded_vocab_size  # lm_head
+    for i in range(cfg.n_layer):
+        if i in cfg.attn_layer_idx:
+            total += d * (a.num_heads + 2 * a.num_heads_kv) * a.head_dim
+            total += a.num_heads * a.head_dim * d
+        else:
+            total += d * ipd + cfg.d_inner * d
+        if cfg.d_intermediate > 0:
+            total += 3 * d * cfg.d_intermediate
+    return total
+
+
+def mamba_fwd_flops_per_token(cfg, seq_len: int) -> float:
+    """Forward FLOPs/token: matmuls + the chunked SSD scan + conv1d +
+    the hybrid attention layers (causal convention as in the Llama
+    accounting)."""
+    mm = 2 * mamba_matmul_params(cfg)
+    L = min(cfg.chunk_size, seq_len)  # ssd_scan clamps the chunk the same way
+    G, N = cfg.ngroups, cfg.d_state
+    H, P = cfg.nheads, cfg.headdim
+    n_mamba = cfg.n_layer - len(cfg.attn_layer_idx)
+    # per token per mamba layer: CB (2*L*G*N), intra y (2*L*H*P),
+    # states + inter-chunk output (4*N*H*P each pair)
+    scan = n_mamba * (2 * L * G * N + 2 * L * H * P + 4 * N * H * P)
+    conv = n_mamba * 2 * (cfg.d_inner + 2 * G * N) * cfg.d_conv
+    a = cfg.attn_cfg
+    attn = len(cfg.attn_layer_idx) * 2 * seq_len * a.num_heads * a.head_dim
+    return mm + scan + conv + attn
+
+
+def mamba_train_flops_per_token(cfg, seq_len: int, ac_fraction: float = 0.0):
+    return mamba_fwd_flops_per_token(cfg, seq_len) * (3 + ac_fraction)
+
+
+def train_flops_per_token(model_cfg, seq_len: int, ac_fraction: float = 0.0):
+    """Family dispatch for MFU/HFU accounting."""
+    if isinstance(model_cfg, LlamaConfig):
+        return llama_train_flops_per_token(model_cfg, seq_len, ac_fraction)
+    return mamba_train_flops_per_token(model_cfg, seq_len, ac_fraction)
+
+
 # Peak dense bf16 TFLOP/s per chip.
 TPU_PEAK_FLOPS = {
     "v5e": 197e12,

@@ -48,9 +48,10 @@ def time_fn(fn, *args, iters=60, reps=3):
     return best
 
 
-def bench(name, fwd, grad, rows):
+def bench(name, fwd, grad, rows, time_scale=1.0):
+    """time_scale multiplies measured time (e.g. head-count normalization)."""
     print(f"# benching {name}", file=sys.stderr)
-    t = time_fn(*fwd)
+    t = time_fn(*fwd) * time_scale
     rows.append(
         {
             "kernel": name,
@@ -59,7 +60,7 @@ def bench(name, fwd, grad, rows):
             "tf_s": round(FWD_FLOPS / t / 1e12, 1),
         }
     )
-    t = time_fn(*grad)
+    t = time_fn(*grad) * time_scale
     rows.append(
         {
             "kernel": name,
@@ -143,25 +144,12 @@ def main():
     def sp_loss(q, k, v):
         return jnp.sum(kernel(q, k, v).astype(jnp.float32))
 
-    scale_heads = N / NSP
-    t = time_fn(sp_fwd, q3, k3, v3)
-    rows.append(
-        {
-            "kernel": f"jax.pallas splash_attention ({NSP}/32 heads, normalized)",
-            "pass": "fwd",
-            "ms": round(t * scale_heads * 1e3, 3),
-            "tf_s": round(FWD_FLOPS / (t * scale_heads) / 1e12, 1),
-        }
-    )
-    gfn = jax.jit(jax.grad(sp_loss, argnums=(0, 1, 2)))
-    t = time_fn(gfn, q3, k3, v3)
-    rows.append(
-        {
-            "kernel": f"jax.pallas splash_attention ({NSP}/32 heads, normalized)",
-            "pass": "fwd+bwd",
-            "ms": round(t * scale_heads * 1e3, 3),
-            "tf_s_at_4.5x": round(FWD_FLOPS * 4.5 / (t * scale_heads) / 1e12, 1),
-        }
+    bench(
+        f"jax.pallas splash_attention ({NSP}/32 heads, normalized)",
+        (sp_fwd, q3, k3, v3),
+        (jax.jit(jax.grad(sp_loss, argnums=(0, 1, 2))), q3, k3, v3),
+        rows,
+        time_scale=N / NSP,
     )
 
     # ---- calibration: plain matmul ceiling
@@ -178,10 +166,12 @@ def main():
         }
     )
 
+    from fms_fsdp_tpu.utils.flops import peak_flops_per_chip
+
     result = {
         "shapes": f"B={B} heads={N} S={S} head_dim={H} causal bf16",
         "chip": os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"),
-        "peak_bf16_tf_s": 197,
+        "peak_bf16_tf_s": round(peak_flops_per_chip() / 1e12),
         "notes": [
             "run-to-run variance through the tunneled chip is ~+/-15% on fwd",
             "splash at 8 heads underestimates its full-batch amortization: a "
