@@ -314,7 +314,13 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc[...]
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout, dlse=None):
+    """Backward for o (and optionally the lse output).
+
+    A differentiable lse output only shifts the per-row delta: the lse
+    cotangent enters as ds_ij += p_ij * dlse_i, and ds is already
+    p * (dp - delta), so delta_eff = delta - dlse — zero kernel changes.
+    """
     q, k, v, o, lse = residuals
     batch, nq, seq_q, head = q.shape
     nkv, seq_k = k.shape[1], k.shape[2]
@@ -323,6 +329,8 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout):
     delta = jnp.sum(
         o.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1, keepdims=True
     )
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block_k=block_k, causal=causal),
@@ -434,6 +442,26 @@ _flash_attention_bnsh.defvjp(
 )
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_lse_bnsh(q, k, v, scale, causal, block_q, block_k, interpret):
+    """(o, lse) with lse (B, N, S, 1) fp32 as a *differentiable* output —
+    the ring-attention building block (partials merge through lse)."""
+    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+
+
+def _flash_attention_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return (o, lse), (q, k, v, o, lse)
+
+
+_flash_attention_lse_bnsh.defvjp(
+    _flash_attention_lse_fwd,
+    lambda scale, causal, bq, bk, interp, res, g: _flash_bwd(
+        scale, causal, bq, bk, interp, res, g[0], dlse=g[1]
+    ),
+)
+
+
 def _pick_block(seq: int, target: int) -> int:
     b = min(seq, target)
     while seq % b != 0:
@@ -472,8 +500,14 @@ def flash_attention(
     block_q: int = 512,
     block_k: int = 512,
     interpret: bool = False,
+    return_lse: bool = False,
 ):
-    """q: (B, S, Nq, H); k/v: (B, S, Nkv, H) -> (B, S, Nq, H)."""
+    """q: (B, S, Nq, H); k/v: (B, S, Nkv, H) -> (B, S, Nq, H).
+
+    With ``return_lse``, also returns the per-query logsumexp
+    (B, S, Nq, 1) fp32 as a differentiable output, enabling exact
+    merging of attention partials over disjoint kv sets (ring attention).
+    """
     scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
     block_q = _pick_block(q.shape[1], block_q)
     block_k = _pick_block(k.shape[1], block_k)
@@ -481,6 +515,11 @@ def flash_attention(
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
+    if return_lse:
+        ot, lse = _flash_attention_lse_bnsh(
+            qt, kt, vt, scale, causal, block_q, block_k, interpret
+        )
+        return jnp.swapaxes(ot, 1, 2), jnp.swapaxes(lse, 1, 2)
     ot = _flash_attention_bnsh(
         qt, kt, vt, scale, causal, block_q, block_k, interpret
     )

@@ -4,16 +4,23 @@
 Beyond-reference capability (the reference has no sequence/context
 parallelism, SURVEY.md §2.b): each device holds S/cp query and kv chunks;
 kv chunks rotate around the ring via ``lax.ppermute`` while every device
-accumulates its queries' attention over each visiting chunk with the
-online-softmax merge (running max / denominator, fp32) — so attention
-memory stays O(S/cp) per device and bandwidth rides the ICI ring.
+merges its queries' attention over each visiting chunk.
 
-Chunk-level masking uses global positions, so the same code handles the
-diagonal, fully-visible, and fully-masked chunk relations without static
-branching. Composes with GQA and the tensor axis (heads split by
-shard_map). The per-chunk partial uses an einsum (scores materialized at
-(S/cp)^2 per device per step); swapping it for the Pallas flash kernel is
-a local change once block-level lse outputs are exposed.
+Chunk relations are decided at chunk granularity — a visiting chunk is
+either fully visible (behind the local queries: plain non-causal flash),
+the diagonal (standard causal flash), or fully in the future (skipped via
+``lax.cond``, no compute). Each partial comes from the Pallas flash
+kernel with its logsumexp exposed (flash_attention(return_lse=True)), so
+per-step memory is O(S/cp * block) — the (S/cp)^2 score materialization
+of the einsum path exists only as the small-shape fallback. Partials
+merge exactly through lse:
+
+    lse' = logaddexp(lse_a, lse_b)
+    o'   = o_a * exp(lse_a - lse') + o_b * exp(lse_b - lse')
+
+Composes with GQA and the tensor axis (heads split by shard_map), and is
+differentiable end-to-end (the lse output carries its own cotangent,
+folded into the flash backward's delta).
 """
 
 import functools
@@ -24,14 +31,18 @@ from jax import lax
 from jax import shard_map  # jax >= 0.8 API (check_vma kwarg)
 from jax.sharding import PartitionSpec as P
 
+from fms_fsdp_tpu.ops.flash_attention import flash_attention
 from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_TENSOR, DATA_AXES
 
 NEG_INF = -1e30
 
 
-def _chunk_partial(q, k, v, q_off, k_off, causal, scale):
-    """Partial attention of local q against one kv chunk at global offset
-    k_off. Returns (o_part, m, l) with o_part = exp(s - m) @ v."""
+def _einsum_partial(q, k, v, causal, scale):
+    """Small-shape fallback: (o_norm, lse) via a materialized score matrix.
+
+    causal here means the *diagonal* chunk relation (q and k share global
+    offsets); fully-visible chunks pass causal=False.
+    """
     b, sq, nq, h = q.shape
     nkv = k.shape[2]
     group = nq // nkv
@@ -43,15 +54,23 @@ def _chunk_partial(q, k, v, q_off, k_off, causal, scale):
         * scale
     )
     if causal:
-        qpos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 0)
-        kpos = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 1)
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 1)
         s = jnp.where(qpos >= kpos, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
-    m = jnp.maximum(m, NEG_INF)  # keep fully-masked rows finite
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     o = jnp.einsum("bkgqs,bskh->bkgqh", p.astype(v.dtype), v)
-    return o.astype(jnp.float32), m, l
+    o = o.astype(jnp.float32) / jnp.maximum(l, 1e-30)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    # (b, nkv, group, sq, ...) -> (b, sq, nq, ...)
+    o = jnp.moveaxis(o, 3, 1).reshape(b, sq, nq, h)
+    lse = jnp.moveaxis(lse, 3, 1).reshape(b, sq, nq, 1)
+    return o, lse
+
+
+def _flash_eligible(s_local: int, head: int) -> bool:
+    return head % 128 == 0 and s_local % 256 == 0
 
 
 def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
@@ -77,6 +96,23 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
         spec_kv = P(spec_kv[0], spec_kv[1], None, None)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
+    s_local = q.shape[1] // cp
+    use_flash = _flash_eligible(s_local, q.shape[-1])
+    interpret = jax.default_backend() == "cpu"
+
+    def partial_fn(q_loc, k_cur, v_cur, diag: bool):
+        if use_flash:
+            return flash_attention(
+                q_loc,
+                k_cur,
+                v_cur,
+                causal=diag,
+                scale=scale,
+                interpret=interpret,
+                return_lse=True,
+            )
+        return _einsum_partial(q_loc, k_cur, v_cur, diag, scale)
+
     @functools.partial(
         shard_map,
         mesh=mesh,
@@ -86,32 +122,51 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
     )
     def inner(q, k, v):
         idx = lax.axis_index(AXIS_CONTEXT)
-        b, s_local, nq, h = q.shape
-        nkv = k.shape[2]
-        group = nq // nkv
-        q_off = idx * s_local
+        b, s_loc, nq, h = q.shape
+
+        def merge(carry, o, lse):
+            acc, lse_run = carry
+            lse_new = jnp.logaddexp(lse_run, lse)
+            # fully-masked-so-far rows: keep weights finite
+            w_run = jnp.exp(jnp.maximum(lse_run - lse_new, NEG_INF))
+            w_new = jnp.exp(jnp.maximum(lse - lse_new, NEG_INF))
+            return acc * w_run + o.astype(jnp.float32) * w_new, lse_new
 
         def body(step, carry):
-            acc, m_run, l_run, k_cur, v_cur = carry
+            acc, lse_run, k_cur, v_cur = carry
             src = (idx - step) % cp  # global chunk currently held
-            k_off = src * s_local
-            o, m, l = _chunk_partial(q, k_cur, v_cur, q_off, k_off, causal, scale)
-            m_new = jnp.maximum(m_run, m)
-            alpha = jnp.exp(m_run - m_new)
-            beta = jnp.exp(m - m_new)
-            acc = acc * alpha + o * beta
-            l_run = l_run * alpha + l * beta
+
+            def diag(_):
+                o, lse = partial_fn(q, k_cur, v_cur, True)
+                return merge((acc, lse_run), o, lse)
+
+            def visible(_):
+                o, lse = partial_fn(q, k_cur, v_cur, False)
+                return merge((acc, lse_run), o, lse)
+
+            def masked(_):
+                return acc, lse_run
+
+            if causal:
+                # chunk relation decides everything: future chunks are
+                # skipped outright, no per-element masks off the diagonal
+                acc_n, lse_n = lax.cond(
+                    src == idx,
+                    diag,
+                    lambda _: lax.cond(src < idx, visible, masked, None),
+                    None,
+                )
+            else:
+                acc_n, lse_n = visible(None)
+
             # rotate kv to the next device (last rotation restores state)
             k_cur = lax.ppermute(k_cur, AXIS_CONTEXT, perm)
             v_cur = lax.ppermute(v_cur, AXIS_CONTEXT, perm)
-            return acc, m_new, l_run, k_cur, v_cur
+            return acc_n, lse_n, k_cur, v_cur
 
-        acc = jnp.zeros((b, nkv, group, s_local, h), jnp.float32)
-        m0 = jnp.full((b, nkv, group, s_local, 1), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, nkv, group, s_local, 1), jnp.float32)
-        acc, m0, l0, _, _ = lax.fori_loop(0, cp, body, (acc, m0, l0, k, v))
-        out = acc / jnp.maximum(l0, 1e-30)
-        out = jnp.moveaxis(out, 3, 1)  # (b, s, nkv, group, h)
-        return out.reshape(b, s_local, nq, h).astype(q.dtype)
+        acc = jnp.zeros((b, s_loc, nq, h), jnp.float32)
+        lse0 = jnp.full((b, s_loc, nq, 1), NEG_INF, jnp.float32)
+        acc, _, _, _ = lax.fori_loop(0, cp, body, (acc, lse0, k, v))
+        return acc.astype(q.dtype)
 
     return inner(q, k, v)
