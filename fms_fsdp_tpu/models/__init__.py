@@ -1,6 +1,6 @@
 from fms_fsdp_tpu.models.configs import LlamaConfig, MambaConfig
 
-__all__ = ["LlamaConfig", "MambaConfig", "get_model_api"]
+__all__ = ["LlamaConfig", "MambaConfig", "get_model_api", "get_base_api"]
 
 
 def get_model_api(model_cfg):
@@ -28,3 +28,63 @@ def get_model_api(model_cfg):
 
         return init_llama_params, llama_forward, llama_param_specs, model_cfg.nlayers
     raise TypeError(f"unknown model config type: {type(model_cfg).__name__}")
+
+
+class BaseModelAPI:
+    """Frozen speculator-base contract (the reference's Embed* registry,
+    ref:speculator/train_speculator_utils.py:430-569): a forward that also
+    yields final hidden states, and a sampling generate that can return
+    per-position embeds."""
+
+    def __init__(self, arch, init_fn, forward_embeds, generate_fn):
+        self.arch = arch
+        self.init = init_fn
+        self.forward_embeds = forward_embeds  # (params, tokens, cfg) -> (logits, embeds)
+        self.generate = generate_fn  # (params, prompts, cfg, key=..., ...) -> toks[, embeds]
+
+
+def get_base_api(arch: str) -> "BaseModelAPI":
+    """arch: the reference's model_arch values — embedllama /
+    embedgptbigcode / embedmixtral (bare HF names accepted too)."""
+    key = arch.lower().removeprefix("embed")
+    if key == "llama":
+        from fms_fsdp_tpu.models.generation import generate
+        from fms_fsdp_tpu.models.llama import init_llama_params, llama_forward
+
+        def fwd(params, tokens, cfg, **kw):
+            return llama_forward(params, tokens, cfg, return_embeds=True, **kw)
+
+        return BaseModelAPI("llama", init_llama_params, fwd, generate)
+    if key in ("gptbigcode", "gpt_bigcode"):
+        from fms_fsdp_tpu.models.gpt_bigcode import (
+            generate_simple,
+            gpt_bigcode_forward,
+            init_gpt_bigcode_params,
+        )
+
+        def fwd(params, tokens, cfg, **kw):
+            return gpt_bigcode_forward(
+                params, tokens, cfg, return_embeds=True, **kw
+            )
+
+        def gen(params, prompts, cfg, **kw):
+            return generate_simple(
+                params, prompts, cfg, gpt_bigcode_forward, **kw
+            )
+
+        return BaseModelAPI("gpt_bigcode", init_gpt_bigcode_params, fwd, gen)
+    if key == "mixtral":
+        from fms_fsdp_tpu.models.gpt_bigcode import generate_simple
+        from fms_fsdp_tpu.models.mixtral import (
+            init_mixtral_params,
+            mixtral_forward,
+        )
+
+        def fwd(params, tokens, cfg, **kw):
+            return mixtral_forward(params, tokens, cfg, return_embeds=True, **kw)
+
+        def gen(params, prompts, cfg, **kw):
+            return generate_simple(params, prompts, cfg, mixtral_forward, **kw)
+
+        return BaseModelAPI("mixtral", init_mixtral_params, fwd, gen)
+    raise ValueError(f"unknown speculator base arch: {arch!r}")

@@ -1,0 +1,168 @@
+"""GPTBigCode (StarCoder family) — speculator base model.
+
+The reference registers an ``EmbedGPTBigCode`` base for speculator
+training (ref:speculator/train_speculator_utils.py:430-500): forward
+that also yields the final hidden states. This is a frozen-base,
+forward-only implementation (no sharding rules / optimizer wiring):
+
+- learned absolute position embeddings (wte + wpe);
+- multi-query attention: one kv head shared by all q heads (the GQA
+  nkv=1 case of ops/attention);
+- fused c_attn projection (q | k | v), gelu MLP, full LayerNorm with
+  bias, tied lm_head (logits = h @ wte^T).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from fms_fsdp_tpu.ops.attention import attention
+from fms_fsdp_tpu.ops.norms import layer_norm
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class GPTBigCodeConfig:
+    src_vocab_size: int = 49152
+    emb_dim: int = 2048
+    nheads: int = 16
+    nlayers: int = 24
+    hidden_grow_factor: float = 4.0
+    max_expected_seq_len: int = 2048
+    ln_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.emb_dim // self.nheads
+
+    @property
+    def hidden_dim(self) -> int:
+        return int(self.emb_dim * self.hidden_grow_factor)
+
+
+def init_gpt_bigcode_params(key, cfg: GPTBigCodeConfig, dtype=jnp.float32) -> Params:
+    d, hd, h = cfg.emb_dim, cfg.head_dim, cfg.hidden_dim
+    std = 0.02
+    keys = iter(jax.random.split(key, 4 * cfg.nlayers + 2))
+
+    def tn(k, shape):
+        return (
+            jax.random.truncated_normal(k, -3, 3, shape, jnp.float32) * std
+        ).astype(dtype)
+
+    L = cfg.nlayers
+    layers = {
+        "ln1_w": jnp.ones((L, d), dtype),
+        "ln1_b": jnp.zeros((L, d), dtype),
+        # fused MQA projection: q (d) | k (hd) | v (hd)
+        "c_attn": jnp.stack([tn(next(keys), (d, d + 2 * hd)) for _ in range(L)]),
+        "attn_proj": jnp.stack([tn(next(keys), (d, d)) for _ in range(L)]),
+        "ln2_w": jnp.ones((L, d), dtype),
+        "ln2_b": jnp.zeros((L, d), dtype),
+        "c_fc": jnp.stack([tn(next(keys), (d, h)) for _ in range(L)]),
+        "mlp_proj": jnp.stack([tn(next(keys), (h, d)) for _ in range(L)]),
+    }
+    return {
+        "wte": tn(next(keys), (cfg.src_vocab_size, d)),
+        "wpe": tn(next(keys), (cfg.max_expected_seq_len, d)),
+        "layers": layers,
+        "ln_f_w": jnp.ones((d,), dtype),
+        "ln_f_b": jnp.zeros((d,), dtype),
+    }
+
+
+def gpt_bigcode_forward(
+    params: Params,
+    tokens,
+    cfg: GPTBigCodeConfig,
+    *,
+    compute_dtype=jnp.bfloat16,
+    positions=None,
+    return_embeds: bool = False,
+    **_unused,
+):
+    """tokens (B, S) -> logits (B, S, V); optionally also the final hidden
+    states (the Embed* contract)."""
+    params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    b, s = tokens.shape
+    assert s <= cfg.max_expected_seq_len, (
+        f"sequence length {s} exceeds max_expected_seq_len "
+        f"{cfg.max_expected_seq_len}: the wpe gather would clamp silently"
+    )
+    d, hd = cfg.emb_dim, cfg.head_dim
+    if positions is None:
+        positions = jnp.arange(s)[None, :]
+    x = params["wte"][tokens] + params["wpe"][positions]
+
+    L = params["layers"]["c_attn"].shape[0]
+    for i in range(L):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.ln_eps)
+        qkv = h @ lp["c_attn"]
+        q = qkv[..., :d].reshape(b, s, cfg.nheads, hd)
+        k = qkv[..., d : d + hd].reshape(b, s, 1, hd)
+        v = qkv[..., d + hd :].reshape(b, s, 1, hd)
+        o = attention(q, k, v, causal=True, impl="xla")
+        x = x + o.reshape(b, s, d) @ lp["attn_proj"]
+        h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.ln_eps)
+        x = x + jax.nn.gelu(h @ lp["c_fc"], approximate=True) @ lp["mlp_proj"]
+
+    embeds = layer_norm(x, params["ln_f_w"], params["ln_f_b"], cfg.ln_eps)
+    logits = embeds @ params["wte"].T  # tied lm head
+    if return_embeds:
+        return logits, embeds
+    return logits
+
+
+def generate_simple(
+    params,
+    input_ids,
+    cfg,
+    forward_fn,
+    *,
+    key,
+    max_new_tokens: int = 8,
+    do_sample: bool = False,
+    temperature: float = 1.0,
+    include_embeds: bool = False,
+    **_unused,
+):
+    """Cache-less greedy/sampled generation by full re-forward — shared by
+    the non-Llama speculator bases (correctness over speed; the Llama base
+    keeps its kv-cached models/generation path).
+
+    The sequence lives in a fixed (B, P+T) buffer written in place via
+    dynamic_update_slice — causal attention makes the trailing padding
+    invisible to earlier positions, so one compile covers every step."""
+    from jax import lax
+
+    b, plen = input_ids.shape
+    total = plen + max_new_tokens
+    toks = jnp.zeros((b, total), input_ids.dtype).at[:, :plen].set(input_ids)
+
+    def step(i, carry):
+        toks, key = carry
+        out = forward_fn(params, toks, cfg)
+        logits_all = out[0] if isinstance(out, tuple) else out
+        logits = lax.dynamic_slice_in_dim(logits_all, i - 1, 1, axis=1)[:, 0]
+        key, sub = jax.random.split(key)
+        sampled = jax.random.categorical(
+            sub, logits.astype(jnp.float32) / temperature, axis=-1
+        )
+        nxt = sampled if do_sample else jnp.argmax(logits, axis=-1)
+        toks = lax.dynamic_update_slice_in_dim(
+            toks, nxt[:, None].astype(toks.dtype), i, axis=1
+        )
+        return toks, key
+
+    toks, _ = lax.fori_loop(plen, total, step, (toks, key))
+    if include_embeds:
+        _, embeds = forward_fn(params, toks, cfg, return_embeds=True)
+        # llama generate contract (models/generation.py): embeds at each
+        # *generated* position = hidden state that predicted that token,
+        # i.e. positions plen-1 .. plen+T-2
+        return toks, embeds[:, plen - 1 : plen - 1 + max_new_tokens]
+    return toks

@@ -1,0 +1,249 @@
+"""HF -> native weight import for speculator base models.
+
+The reference loads its speculator bases from HF-format checkpoints via
+``fms.models.get_model(..., source="hf")``
+(ref:speculator/train_speculator.py:115-131). Equivalent here: read a
+local HF checkpoint directory with transformers and map the state dict
+onto our native param trees. For Llama this is the exact inverse of
+fms_to_hf_llama.params_to_hf_state_dict (transposes + naming).
+
+Supported architectures (the reference's Embed* registry,
+ref:speculator/train_speculator_utils.py:430-569):
+  llama       -> models/llama.py tree
+  gpt_bigcode -> models/gpt_bigcode.py tree
+  mixtral     -> models/mixtral.py tree
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from fms_fsdp_tpu.models.configs import LlamaConfig
+from fms_fsdp_tpu.models.gpt_bigcode import GPTBigCodeConfig
+from fms_fsdp_tpu.models.mixtral import MixtralConfig
+
+
+def is_hf_checkpoint(path: str) -> bool:
+    import os
+
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, "config.json")
+    )
+
+
+def _sd(model):
+    return {
+        k: np.asarray(v.detach().to("cpu").float().numpy())
+        for k, v in model.state_dict().items()
+    }
+
+
+def _to(x, dtype):
+    return jnp.asarray(x, dtype=dtype)
+
+
+def _stack(sd, fmt, nlayers, dtype, transpose=True):
+    """Per-layer weights -> one stacked (L, ...) array; Linear weights
+    (out, in) transpose to our (in, out)."""
+    mats = [sd[fmt.format(i)] for i in range(nlayers)]
+    if transpose:
+        mats = [m.T for m in mats]
+    return _to(np.stack(mats), dtype)
+
+
+# ---------------------------------------------------------------------------
+# llama
+# ---------------------------------------------------------------------------
+
+
+def llama_config_from_hf(hf_cfg) -> LlamaConfig:
+    return LlamaConfig(
+        src_vocab_size=hf_cfg.vocab_size,
+        emb_dim=hf_cfg.hidden_size,
+        nheads=hf_cfg.num_attention_heads,
+        kvheads=(
+            0
+            if hf_cfg.num_key_value_heads == hf_cfg.num_attention_heads
+            else hf_cfg.num_key_value_heads
+        ),
+        nlayers=hf_cfg.num_hidden_layers,
+        # +0.5 then truncate: guarantees hidden_dim == intermediate_size
+        # exactly regardless of float rounding in the ratio
+        hidden_grow_factor=(hf_cfg.intermediate_size + 0.5)
+        / hf_cfg.hidden_size,
+        multiple_of=1,
+        max_expected_seq_len=hf_cfg.max_position_embeddings,
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        norm_eps=hf_cfg.rms_norm_eps,
+    )
+
+
+def hf_to_llama_params(model, cfg: LlamaConfig, dtype=jnp.bfloat16):
+    """transformers LlamaForCausalLM -> native param tree (stacked layers)."""
+    sd = _sd(model)
+
+    def t(key):
+        return sd[key].T
+
+    def stack(fmt, transpose=True):
+        return _stack(sd, fmt, cfg.nlayers, dtype, transpose)
+
+    return {
+        "embedding": _to(sd["model.embed_tokens.weight"], dtype),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", False),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "ffn_norm": stack(
+                "model.layers.{}.post_attention_layernorm.weight", False
+            ),
+            "w1": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "w3": stack("model.layers.{}.mlp.up_proj.weight"),
+            "w2": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+        "norm": _to(sd["model.norm.weight"], dtype),
+        "lm_head": _to(t("lm_head.weight"), dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# gpt_bigcode
+# ---------------------------------------------------------------------------
+
+
+def gpt_bigcode_config_from_hf(hf_cfg) -> GPTBigCodeConfig:
+    if not getattr(hf_cfg, "multi_query", True):
+        raise ValueError(
+            "GPTBigCode import supports the multi_query=True layout only "
+            "(the StarCoder family); this checkpoint uses full MHA"
+        )
+    return GPTBigCodeConfig(
+        src_vocab_size=hf_cfg.vocab_size,
+        emb_dim=hf_cfg.n_embd,
+        nheads=hf_cfg.n_head,
+        nlayers=hf_cfg.n_layer,
+        hidden_grow_factor=(hf_cfg.n_inner or 4 * hf_cfg.n_embd) / hf_cfg.n_embd,
+        max_expected_seq_len=hf_cfg.n_positions,
+        ln_eps=hf_cfg.layer_norm_epsilon,
+    )
+
+
+def hf_to_gpt_bigcode_params(model, cfg: GPTBigCodeConfig, dtype=jnp.bfloat16):
+    sd = _sd(model)
+
+    def stack(fmt, transpose=True):
+        return _stack(sd, fmt, cfg.nlayers, dtype, transpose)
+
+    return {
+        "wte": _to(sd["transformer.wte.weight"], dtype),
+        "wpe": _to(sd["transformer.wpe.weight"], dtype),
+        "layers": {
+            "ln1_w": stack("transformer.h.{}.ln_1.weight", False),
+            "ln1_b": stack("transformer.h.{}.ln_1.bias", False),
+            "c_attn": stack("transformer.h.{}.attn.c_attn.weight"),
+            "attn_proj": stack("transformer.h.{}.attn.c_proj.weight"),
+            "ln2_w": stack("transformer.h.{}.ln_2.weight", False),
+            "ln2_b": stack("transformer.h.{}.ln_2.bias", False),
+            "c_fc": stack("transformer.h.{}.mlp.c_fc.weight"),
+            "mlp_proj": stack("transformer.h.{}.mlp.c_proj.weight"),
+        },
+        "ln_f_w": _to(sd["transformer.ln_f.weight"], dtype),
+        "ln_f_b": _to(sd["transformer.ln_f.bias"], dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# mixtral
+# ---------------------------------------------------------------------------
+
+
+def mixtral_config_from_hf(hf_cfg) -> MixtralConfig:
+    return MixtralConfig(
+        src_vocab_size=hf_cfg.vocab_size,
+        emb_dim=hf_cfg.hidden_size,
+        nheads=hf_cfg.num_attention_heads,
+        kvheads=hf_cfg.num_key_value_heads,
+        nlayers=hf_cfg.num_hidden_layers,
+        hidden_dim=hf_cfg.intermediate_size,
+        num_experts=hf_cfg.num_local_experts,
+        top_k=hf_cfg.num_experts_per_tok,
+        max_expected_seq_len=hf_cfg.max_position_embeddings,
+        rope_theta=hf_cfg.rope_theta,
+        norm_eps=hf_cfg.rms_norm_eps,
+    )
+
+
+def hf_to_mixtral_params(model, cfg: MixtralConfig, dtype=jnp.bfloat16):
+    sd = _sd(model)
+
+    def stack(fmt, transpose=True):
+        return _stack(sd, fmt, cfg.nlayers, dtype, transpose)
+
+    def stack_experts(fmt):
+        # (L, E, in, out) from per-expert Linear weights (out, in)
+        return _to(
+            np.stack(
+                [
+                    np.stack(
+                        [
+                            sd[fmt.format(i, e)].T
+                            for e in range(cfg.num_experts)
+                        ]
+                    )
+                    for i in range(cfg.nlayers)
+                ]
+            ),
+            dtype,
+        )
+
+    return {
+        "embedding": _to(sd["model.embed_tokens.weight"], dtype),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", False),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "ffn_norm": stack(
+                "model.layers.{}.post_attention_layernorm.weight", False
+            ),
+            "gate": stack("model.layers.{}.block_sparse_moe.gate.weight"),
+            "w1": stack_experts("model.layers.{}.block_sparse_moe.experts.{}.w1.weight"),
+            "w3": stack_experts("model.layers.{}.block_sparse_moe.experts.{}.w3.weight"),
+            "w2": stack_experts("model.layers.{}.block_sparse_moe.experts.{}.w2.weight"),
+        },
+        "norm": _to(sd["model.norm.weight"], dtype),
+        "lm_head": _to(sd["lm_head.weight"].T, dtype),
+    }
+
+
+# ---------------------------------------------------------------------------
+# loader
+# ---------------------------------------------------------------------------
+
+_ARCHS = {
+    "llama": (llama_config_from_hf, hf_to_llama_params),
+    "gpt_bigcode": (gpt_bigcode_config_from_hf, hf_to_gpt_bigcode_params),
+    "mixtral": (mixtral_config_from_hf, hf_to_mixtral_params),
+}
+
+
+def load_hf_base(path: str, dtype=jnp.bfloat16):
+    """Load a local HF checkpoint; returns (arch, native_cfg, params)."""
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_cfg = AutoConfig.from_pretrained(path)
+    arch = hf_cfg.model_type
+    if arch not in _ARCHS:
+        raise ValueError(
+            f"unsupported HF base architecture {arch!r}; "
+            f"supported: {sorted(_ARCHS)}"
+        )
+    model = AutoModelForCausalLM.from_pretrained(path, torch_dtype="float32")
+    cfg_fn, map_fn = _ARCHS[arch]
+    cfg = cfg_fn(hf_cfg)
+    params = map_fn(model, cfg, dtype=dtype)
+    del model
+    return arch, cfg, params

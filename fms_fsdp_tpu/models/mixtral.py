@@ -1,0 +1,134 @@
+"""Mixtral (sparse MoE Llama-family) — speculator base model.
+
+The reference registers an ``EmbedMixtral`` base for speculator training
+(ref:speculator/train_speculator_utils.py:500-569). Frozen-base,
+forward-only implementation: Llama-style attention (GQA + RoPE +
+RMSNorm) with the FFN replaced by a top-2-of-E SwiGLU mixture.
+
+Routing computes every expert densely and mixes with the (renormalized)
+top-2 softmax weights — for a frozen base this trades FLOPs (E/2 extra)
+for exact, jit-friendly static shapes; a capacity-based gather/scatter
+dispatch is the training-scale optimization, not needed for a frozen
+teacher.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from fms_fsdp_tpu.ops.attention import attention
+from fms_fsdp_tpu.ops.norms import rms_norm
+from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    src_vocab_size: int = 32000
+    emb_dim: int = 4096
+    nheads: int = 32
+    kvheads: int = 8
+    nlayers: int = 32
+    hidden_dim: int = 14336
+    num_experts: int = 8
+    top_k: int = 2
+    max_expected_seq_len: int = 4096
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.emb_dim // self.nheads
+
+
+def init_mixtral_params(key, cfg: MixtralConfig, dtype=jnp.float32) -> Params:
+    d, hd, h, E = cfg.emb_dim, cfg.head_dim, cfg.hidden_dim, cfg.num_experts
+    std = 0.02
+    keys = iter(jax.random.split(key, 8 * cfg.nlayers + 3))
+
+    def tn(k, shape):
+        return (
+            jax.random.truncated_normal(k, -3, 3, shape, jnp.float32) * std
+        ).astype(dtype)
+
+    L = cfg.nlayers
+    layers = {
+        "attn_norm": jnp.ones((L, d), dtype),
+        "wq": jnp.stack([tn(next(keys), (d, cfg.nheads * hd)) for _ in range(L)]),
+        "wk": jnp.stack([tn(next(keys), (d, cfg.kvheads * hd)) for _ in range(L)]),
+        "wv": jnp.stack([tn(next(keys), (d, cfg.kvheads * hd)) for _ in range(L)]),
+        "wo": jnp.stack([tn(next(keys), (cfg.nheads * hd, d)) for _ in range(L)]),
+        "ffn_norm": jnp.ones((L, d), dtype),
+        "gate": jnp.stack([tn(next(keys), (d, E)) for _ in range(L)]),
+        "w1": jnp.stack([tn(next(keys), (E, d, h)) for _ in range(L)]),
+        "w3": jnp.stack([tn(next(keys), (E, d, h)) for _ in range(L)]),
+        "w2": jnp.stack([tn(next(keys), (E, h, d)) for _ in range(L)]),
+    }
+    return {
+        "embedding": tn(next(keys), (cfg.src_vocab_size, d)),
+        "layers": layers,
+        "norm": jnp.ones((d,), dtype),
+        "lm_head": tn(next(keys), (d, cfg.src_vocab_size)),
+    }
+
+
+def _moe_ffn(h, gate_w, w1, w3, w2, top_k):
+    """Dense-mix top-k MoE SwiGLU. h (B, S, D); w1/w3 (E, D, H); w2 (E, H, D)."""
+    router = (h @ gate_w).astype(jnp.float32)  # (B, S, E)
+    top_vals, top_idx = jax.lax.top_k(router, top_k)
+    weights = jax.nn.softmax(top_vals, axis=-1)  # renormalized over top-k
+    E = gate_w.shape[-1]
+    # scatter the top-k weights back to a dense (B, S, E) mixing matrix
+    mix = jnp.sum(
+        jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
+        * weights[..., None],
+        axis=-2,
+    )
+    expert_out = jnp.einsum(
+        "bseh,ehd->bsed",
+        jax.nn.silu(jnp.einsum("bsd,edh->bseh", h, w1))
+        * jnp.einsum("bsd,edh->bseh", h, w3),
+        w2,
+    )  # (B, S, E, D)
+    return jnp.einsum("bse,bsed->bsd", mix.astype(h.dtype), expert_out)
+
+
+def mixtral_forward(
+    params: Params,
+    tokens,
+    cfg: MixtralConfig,
+    *,
+    compute_dtype=jnp.bfloat16,
+    return_embeds: bool = False,
+    **_unused,
+):
+    """tokens (B, S) -> logits (B, S, V); optionally the final hidden
+    states (the Embed* contract)."""
+    params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    b, s = tokens.shape
+    hd = cfg.head_dim
+    x = params["embedding"][tokens]
+    cos, sin = rope_table(s, hd, cfg.rope_theta)
+
+    L = params["layers"]["wq"].shape[0]
+    for i in range(L):
+        lp = jax.tree.map(lambda a: a[i], params["layers"])
+        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(b, s, cfg.nheads, hd)
+        k = (h @ lp["wk"]).reshape(b, s, cfg.kvheads, hd)
+        v = (h @ lp["wv"]).reshape(b, s, cfg.kvheads, hd)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+        o = attention(q, k, v, causal=True, impl="xla")
+        x = x + o.reshape(b, s, -1) @ lp["wo"]
+        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
+        x = x + _moe_ffn(h, lp["gate"], lp["w1"], lp["w3"], lp["w2"], cfg.top_k)
+
+    embeds = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = embeds @ params["lm_head"]
+    if return_embeds:
+        return logits, embeds
+    return logits

@@ -17,3 +17,15 @@ def rms_norm(x, weight, eps: float = 1e-5):
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
     y = x32 * lax.rsqrt(var + eps)
     return (y * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    """Full LayerNorm (mean subtraction + bias) for the GPT-family bases
+    (GPTBigCode); fp32 statistics, result in x.dtype."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mean) ** 2, axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    y = y * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(dtype)

@@ -69,8 +69,19 @@ def _einsum_partial(q, k, v, causal, scale):
     return o, lse
 
 
-def _flash_eligible(s_local: int, head: int) -> bool:
-    return head % 128 == 0 and s_local % 256 == 0
+def _flash_eligible(q_shape, kv_shape, cp: int) -> bool:
+    """Local-chunk eligibility for the Pallas partials: the kernel's own
+    supports() gate at the per-device shapes, on a backend that can run it
+    (TPU, or CPU via interpret mode)."""
+    from fms_fsdp_tpu.ops.flash_attention import supports
+
+    b, s, nq, h = q_shape
+    local_q = (b, s // cp, nq, h)
+    local_kv = (kv_shape[0], kv_shape[1] // cp, kv_shape[2], kv_shape[3])
+    return supports(local_q, local_kv) and jax.default_backend() in (
+        "tpu",
+        "cpu",
+    )
 
 
 def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
@@ -96,8 +107,7 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
         spec_kv = P(spec_kv[0], spec_kv[1], None, None)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
-    s_local = q.shape[1] // cp
-    use_flash = _flash_eligible(s_local, q.shape[-1])
+    use_flash = _flash_eligible(q.shape, k.shape, cp)
     interpret = jax.default_backend() == "cpu"
 
     def partial_fn(q_loc, k_cur, v_cur, diag: bool):

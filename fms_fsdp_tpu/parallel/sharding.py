@@ -139,7 +139,13 @@ def infer_state_specs(state_shapes, param_specs, params_subtree: str = "params")
 
 
 def shard_params(params, specs, mesh: Mesh):
-    """Place a param pytree on the mesh per the spec tree (host -> device)."""
+    """Place a param pytree on the mesh per the spec tree (host -> device).
+
+    ``specs=None`` replicates every leaf — the frozen-base fallback for
+    model families without a dedicated spec rulebook."""
+    if specs is None:
+        sharding = jax.sharding.NamedSharding(mesh, P())
+        return jax.device_put(params, sharding)
     shardings = jax.tree.map(
         lambda p, s: named_sharding(mesh, s, np.shape(p)),
         params,

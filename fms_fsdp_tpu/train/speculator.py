@@ -24,8 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from fms_fsdp_tpu.models.generation import generate
-from fms_fsdp_tpu.models.llama import llama_forward
+from fms_fsdp_tpu.models import get_base_api
 from fms_fsdp_tpu.models.speculator import SpeculatorConfig, speculator_forward
 from fms_fsdp_tpu.train.step import cross_entropy_loss
 
@@ -78,20 +77,22 @@ def _per_head_ce(preds, targets_fn):
     return sum(losses), jnp.stack(losses)
 
 
-def make_stage1_step(base_params, model_cfg, scfg: SpeculatorConfig, cfg, optimizer):
+def make_stage1_step(
+    base_params, model_cfg, scfg: SpeculatorConfig, cfg, optimizer, base_api=None
+):
     """(spec_state, input (B, L)) -> (spec_state, metrics). Ground-truth
     feed: embeds over input[:, :-n-1], head i scored against
     input[:, i+2 : N+i+2] (ref:train_speculator_utils.py:122-171)."""
+    base_api = base_api or get_base_api("embedllama")
     n_predict = scfg.n_predict
     schedule = get_speculator_lr_schedule(cfg)
 
     def loss_fn(spec_params, inputs):
-        _, embeds = llama_forward(
+        _, embeds = base_api.forward_embeds(
             base_params,
             inputs[:, : -n_predict - 1],
             model_cfg,
             attn_impl=cfg.attention_kernel,
-            return_embeds=True,
         )
         embeds = jax.lax.stop_gradient(embeds)
         preds = speculator_forward(spec_params, embeds, inputs[:, 1:], scfg)
@@ -111,11 +112,14 @@ def make_stage1_step(base_params, model_cfg, scfg: SpeculatorConfig, cfg, optimi
     return step
 
 
-def make_stage2_step(base_params, model_cfg, scfg: SpeculatorConfig, cfg, optimizer):
+def make_stage2_step(
+    base_params, model_cfg, scfg: SpeculatorConfig, cfg, optimizer, base_api=None
+):
     """Stage 2: base generates stage2_seq_length tokens from
     stage2_prompt_length prompts (batch reshaped to stage2_batch_size rows),
     and the speculator matches the generated stream
     (ref:train_speculator_utils.py:175-242)."""
+    base_api = base_api or get_base_api("embedllama")
     n_predict = scfg.n_predict
     s2_prompt = cfg.stage2_prompt_length
     s2_seq = cfg.stage2_seq_length
@@ -127,7 +131,7 @@ def make_stage2_step(base_params, model_cfg, scfg: SpeculatorConfig, cfg, optimi
 
     def loss_fn(spec_params, inputs, key):
         prompts = inputs[:, : s2_prompt * grow].reshape(-1, s2_prompt)
-        targs, embeds = generate(
+        targs, embeds = base_api.generate(
             base_params,
             prompts,
             model_cfg,
@@ -212,13 +216,16 @@ def train_speculator(
     n_tok=0,
     profiler=None,
     ckpt_loader=None,
+    base_api=None,
 ):
     """Speculator host loop with the reference's reporting/ckpt cadence
     (ref:train_speculator_utils.py:263-427). ``train_loader`` yields global
     input batches (e.g. a DeviceFeed); ``ckpt_loader`` is the stateful
     pipeline object whose state gets checkpointed (defaults to
     train_loader when it exposes save_to_path)."""
-    stage1 = make_stage1_step(base_params, model_cfg, scfg, cfg, optimizer)
+    stage1 = make_stage1_step(
+        base_params, model_cfg, scfg, cfg, optimizer, base_api
+    )
     stage2 = None  # built lazily: its batch-partition constraints only
     # apply once stage 2 actually starts
     key = jax.random.PRNGKey(cfg.seed + 17)
@@ -253,7 +260,7 @@ def train_speculator(
         else:
             if stage2 is None:
                 stage2 = make_stage2_step(
-                    base_params, model_cfg, scfg, cfg, optimizer
+                    base_params, model_cfg, scfg, cfg, optimizer, base_api
                 )
             key, sub = jax.random.split(key)
             spec_state, metrics = stage2(spec_state, inputs, sub)

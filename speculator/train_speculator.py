@@ -23,8 +23,8 @@ from fms_fsdp_tpu.config import TrainConfig
 from fms_fsdp_tpu.data import get_data_loader, get_dummy_loader
 from fms_fsdp_tpu.data.device_feed import DeviceFeed
 from fms_fsdp_tpu.data.loader import rebatch
-from fms_fsdp_tpu.models.generation import generate
-from fms_fsdp_tpu.models.llama import init_llama_params
+from fms_fsdp_tpu.models import get_base_api
+from fms_fsdp_tpu.models.hf_import import is_hf_checkpoint, load_hf_base
 from fms_fsdp_tpu.models.speculator import (
     SpeculatorConfig,
     init_speculator_params,
@@ -45,11 +45,11 @@ from fms_fsdp_tpu.utils.train_utils import (
 )
 
 
-def test_model(rank, base_params, model_cfg, cfg):
+def test_model(rank, base_params, model_cfg, cfg, base_api):
     """Sanity generation check on the loaded base model
     (ref:speculator/train_speculator.py:34-60 analog)."""
     prompt = jnp.arange(16, dtype=jnp.int32)[None, :] % model_cfg.src_vocab_size
-    out = generate(
+    out = base_api.generate(
         base_params,
         prompt,
         model_cfg,
@@ -85,27 +85,60 @@ def main(**kwargs):
     )
     mesh = build_mesh(mesh_cfg)
 
-    # frozen base model
-    model_cfg = get_model_config(cfg.model_variant)
-    update_config(model_cfg, **kwargs)
-    base_params = init_llama_params(
-        jax.random.PRNGKey(cfg.seed), model_cfg, dtype=jnp.bfloat16
-    )
-    base_params = shard_params(base_params, llama_param_specs(), mesh)
-    if cfg.model_path and os.path.exists(cfg.model_path):
-        loader_ck = Checkpointer(
-            os.path.join(cfg.ckpt_save_path, "_base_load"), 1, "ddp", rank
+    # frozen base model. Three sources, mirroring the reference's
+    # fms.models.get_model(arch, variant, model_path, source="hf"|...)
+    # (ref:speculator/train_speculator.py:115-131):
+    #   1. an HF-format checkpoint dir at model_path (any supported arch),
+    #   2. a native checkpoint at model_path (llama),
+    #   3. random init (smoke-test mode).
+    base_api = get_base_api(cfg.model_arch)
+    if cfg.model_path and is_hf_checkpoint(cfg.model_path):
+        arch, model_cfg, base_params = load_hf_base(cfg.model_path)
+        if arch != base_api.arch:
+            if rank == 0:
+                print(f"model_arch={cfg.model_arch} overridden by HF "
+                      f"checkpoint arch {arch}")
+            base_api = get_base_api(arch)
+        base_params = shard_params(
+            base_params,
+            llama_param_specs() if arch == "llama" else None,
+            mesh,
         )
-        state = {"params": base_params}
-        state, _, _, _, _ = loader_ck.load(state, None, path=cfg.model_path)
-        base_params = state["params"]
-    elif rank == 0:
-        print(
-            f"No base checkpoint at {cfg.model_path}; using random init "
-            "(smoke-test mode)"
-        )
+    else:
+        if base_api.arch == "llama":
+            model_cfg = get_model_config(cfg.model_variant)
+        else:
+            from fms_fsdp_tpu.models.gpt_bigcode import GPTBigCodeConfig
+            from fms_fsdp_tpu.models.mixtral import MixtralConfig
 
-    test_model(rank, base_params, model_cfg, cfg)
+            model_cfg = (
+                GPTBigCodeConfig()
+                if base_api.arch == "gpt_bigcode"
+                else MixtralConfig()
+            )
+        update_config(model_cfg, **kwargs)
+        base_params = base_api.init(
+            jax.random.PRNGKey(cfg.seed), model_cfg, dtype=jnp.bfloat16
+        )
+        base_params = shard_params(
+            base_params,
+            llama_param_specs() if base_api.arch == "llama" else None,
+            mesh,
+        )
+        if cfg.model_path and os.path.exists(cfg.model_path):
+            loader_ck = Checkpointer(
+                os.path.join(cfg.ckpt_save_path, "_base_load"), 1, "ddp", rank
+            )
+            state = {"params": base_params}
+            state, _, _, _, _ = loader_ck.load(state, None, path=cfg.model_path)
+            base_params = state["params"]
+        elif rank == 0:
+            print(
+                f"No base checkpoint at {cfg.model_path}; using random init "
+                "(smoke-test mode)"
+            )
+
+    test_model(rank, base_params, model_cfg, cfg, base_api)
 
     # speculator (replicated: NO_SHARD analog, ref:train_speculator.py:201)
     scfg = SpeculatorConfig.from_train_config(
@@ -163,6 +196,7 @@ def main(**kwargs):
         tokens_seen,
         profiler,
         ckpt_loader=ckpt_loader,
+        base_api=base_api,
     )
 
 

@@ -1,0 +1,225 @@
+"""HF -> native import and speculator base-arch tests.
+
+Roundtrip pins the mapping: native params -> HF model (fms_to_hf_llama)
+-> native params (hf_import) must reproduce logits exactly; GPTBigCode /
+Mixtral bases are checked against their transformers implementations; the
+speculator smoke trains against an HF-format Llama checkpoint dir (the
+reference's source="hf" flow, ref:speculator/train_speculator.py:115-131).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fms_fsdp_tpu.models.configs import LlamaConfig
+
+TINY = LlamaConfig(
+    src_vocab_size=128,
+    emb_dim=64,
+    nheads=4,
+    kvheads=2,
+    nlayers=2,
+    multiple_of=16,
+    max_expected_seq_len=64,
+)
+
+
+def _save_tiny_hf_llama(tmp_path):
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parents[1]))
+    from fms_to_hf_llama import convert_to_hf
+
+    from fms_fsdp_tpu.models.llama import init_llama_params
+
+    params = init_llama_params(jax.random.PRNGKey(0), TINY)
+    hf_model = convert_to_hf(params, TINY)
+    out = str(tmp_path / "hf_llama")
+    hf_model.save_pretrained(out, safe_serialization=True)
+    return params, out
+
+
+def test_hf_llama_roundtrip_exact(tmp_path):
+    from fms_fsdp_tpu.models.hf_import import is_hf_checkpoint, load_hf_base
+    from fms_fsdp_tpu.models.llama import llama_forward
+
+    params, path = _save_tiny_hf_llama(tmp_path)
+    assert is_hf_checkpoint(path)
+    arch, cfg2, params2 = load_hf_base(path, dtype=jnp.float32)
+    assert arch == "llama"
+    assert cfg2.hidden_dim == TINY.hidden_dim
+    assert cfg2.n_kv_heads == TINY.n_kv_heads
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 128)
+    a = llama_forward(params, tokens, TINY, compute_dtype=jnp.float32)
+    b = llama_forward(params2, tokens, cfg2, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_gpt_bigcode_matches_transformers(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import GPTBigCodeConfig as HFCfg, GPTBigCodeForCausalLM
+
+    hf_cfg = HFCfg(
+        vocab_size=96,
+        n_positions=64,
+        n_embd=64,
+        n_layer=2,
+        n_head=4,
+        n_inner=128,
+        multi_query=True,
+        attn_pdrop=0.0,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+    )
+    hf_model = GPTBigCodeForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / "hf_bigcode")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    from fms_fsdp_tpu.models.gpt_bigcode import gpt_bigcode_forward
+    from fms_fsdp_tpu.models.hf_import import load_hf_base
+
+    arch, cfg, params = load_hf_base(path, dtype=jnp.float32)
+    assert arch == "gpt_bigcode"
+
+    ids = np.arange(24).reshape(2, 12) % 96
+    ours = gpt_bigcode_forward(
+        params, jnp.asarray(ids), cfg, compute_dtype=jnp.float32
+    )
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
+
+
+def test_mixtral_matches_transformers(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import MixtralConfig as HFCfg, MixtralForCausalLM
+
+    hf_cfg = HFCfg(
+        vocab_size=96,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+    )
+    hf_model = MixtralForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / "hf_mixtral")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    from fms_fsdp_tpu.models.hf_import import load_hf_base
+    from fms_fsdp_tpu.models.mixtral import mixtral_forward
+
+    arch, cfg, params = load_hf_base(path, dtype=jnp.float32)
+    assert arch == "mixtral"
+
+    ids = np.arange(24).reshape(2, 12) % 96
+    ours = mixtral_forward(
+        params, jnp.asarray(ids), cfg, compute_dtype=jnp.float32
+    )
+    with torch.no_grad():
+        theirs = hf_model(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
+
+
+def test_generate_simple_matches_prefix():
+    """generate_simple continues a prompt deterministically and returns
+    embeds shaped over the full sequence."""
+    from fms_fsdp_tpu.models.gpt_bigcode import (
+        GPTBigCodeConfig,
+        generate_simple,
+        gpt_bigcode_forward,
+        init_gpt_bigcode_params,
+    )
+
+    cfg = GPTBigCodeConfig(
+        src_vocab_size=64, emb_dim=32, nheads=2, nlayers=2,
+        max_expected_seq_len=32,
+    )
+    params = init_gpt_bigcode_params(jax.random.PRNGKey(0), cfg)
+    prompt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    toks, embeds = generate_simple(
+        params, prompt, cfg, gpt_bigcode_forward,
+        key=jax.random.PRNGKey(1), max_new_tokens=4, include_embeds=True,
+    )
+    assert toks.shape == (1, 12)
+    # llama contract: embeds at generated positions only (B, T, D)
+    assert embeds.shape == (1, 4, 32)
+    np.testing.assert_array_equal(np.asarray(toks[:, :8]), np.asarray(prompt))
+    # embeds[j] must be the hidden state at position plen-1+j (the state
+    # that predicted generated token j)
+    _, full_embeds = gpt_bigcode_forward(
+        params, toks, cfg, return_embeds=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(embeds), np.asarray(full_embeds[:, 7:11]), atol=1e-6
+    )
+
+
+def test_speculator_gpt_bigcode_base_stage2(tmp_path):
+    """Speculator trains on a GPTBigCode base through stage 2 (the
+    reference's EmbedGPTBigCode flow with base-generated targets)."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parents[1]))
+    from speculator.train_speculator import main
+
+    main(
+        model_arch="embedgptbigcode",
+        model_path="/nonexistent",
+        use_dummy_dataset=True,
+        ckpt_save_path=str(tmp_path / "ckpt"),
+        ckpt_load_path=str(tmp_path / "ckpt"),
+        batch_size=2,
+        seq_length=32,
+        vocab_size=64,
+        num_steps=3,
+        report_interval=1,
+        checkpoint_interval=10000,
+        stage2_start_step=1,
+        stage2_batch_size=4,
+        stage2_prompt_length=8,
+        stage2_seq_length=16,
+        n_speculator_heads=2,
+        speculator_width=32,
+        sharding_strategy="fsdp",
+        src_vocab_size=64,
+        emb_dim=32,
+        nheads=2,
+        nlayers=2,
+        max_expected_seq_len=64,
+    )
+
+
+def test_speculator_trains_against_hf_llama(tmp_path):
+    """End-to-end: speculator stage-1 steps against an HF-format Llama
+    base loaded from disk (the verdict's done-criterion for base parity)."""
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parents[1]))
+    _, path = _save_tiny_hf_llama(tmp_path)
+
+    from speculator.train_speculator import main
+
+    main(
+        model_arch="embedllama",
+        model_path=path,
+        use_dummy_dataset=True,
+        ckpt_save_path=str(tmp_path / "ckpt"),
+        ckpt_load_path=str(tmp_path / "ckpt"),
+        batch_size=2,
+        seq_length=32,
+        num_steps=3,
+        report_interval=1,
+        checkpoint_interval=10000,
+        stage2_start_step=100,
+        n_speculator_heads=2,
+        speculator_width=64,
+        sharding_strategy="fsdp",
+    )
