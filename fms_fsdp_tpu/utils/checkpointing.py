@@ -12,7 +12,8 @@ contract:
   provided path (continued pretraining) with step/stat reset;
 - single-file checkpoints (ddp/speculator path) hold a bare model param
   tree and reset optimizer/step;
-- rolling cleanup of 'tmp'-qualified checkpoints beyond ``n_to_save``.
+- rolling retention of the newest ``n_to_save`` step checkpoints (ordered
+  by the step number in the name).
 
 Sharded tensor IO is Orbax/TensorStore: every process writes only its own
 array shards in parallel (the FileSystemWriter single-file-per-rank
@@ -33,7 +34,12 @@ from pathlib import Path
 
 import jax
 
-from fms_fsdp_tpu.utils.ckpt_paths import get_latest, get_oldest
+from fms_fsdp_tpu.utils.ckpt_paths import (
+    get_latest,
+    get_oldest,
+    is_step_ckp,
+    step_number,
+)
 
 
 def load_params_only(load_path: str, init_params_fn):
@@ -78,7 +84,7 @@ def load_params_only(load_path: str, init_params_fn):
     }
     state_dir = os.path.join(load_path, "state")
     if not os.path.isdir(state_dir):
-        latest = get_latest(load_path)
+        latest = get_latest(load_path, qualifier=is_step_ckp, key=step_number)
         assert latest is not None, f"no checkpoint under {load_path}"
         state_dir = os.path.join(latest, "state")
     restored = ocp.PyTreeCheckpointer().restore(
@@ -147,7 +153,10 @@ class Checkpointer:
         if "metadata.json" in entries:
             return path
         if len(entries) > 0:
-            latest = get_latest(path)
+            # only step_<N>_ckp entries qualify (by step number, not
+            # ctime): foreign files parked in the folder must not shadow
+            # real checkpoints
+            latest = get_latest(path, qualifier=is_step_ckp, key=step_number)
             if latest is None:
                 return None
             if os.path.isfile(latest):
@@ -159,16 +168,27 @@ class Checkpointer:
     # -- cleanup ------------------------------------------------------------
 
     def _cleanup(self):
-        """Delete oldest 'tmp'-qualified checkpoints beyond max_ckps
-        (ref:checkpointing_utils.py:120-135)."""
-        if (
-            self.rank == 0
-            and len([x for x in os.listdir(self.ckp_path) if "tmp" in x])
+        """Rolling retention: delete the oldest saved step checkpoints
+        beyond max_ckps. The reference's equivalent filters on a 'tmp'
+        qualifier its own save path never produces
+        (ref:checkpointing_utils.py:120-135 vs :299), so its advertised
+        n_to_save retention silently never fires — here the filter matches
+        the names ``save`` actually writes (step_<N>_ckp)."""
+        if self.rank != 0:
+            return None
+        while (
+            len([x for x in os.listdir(self.ckp_path) if is_step_ckp(x)])
             > self.max_ckps
         ):
-            ckp_to_remove = Path(
-                get_oldest(self.ckp_path, qualifier=lambda x: "tmp" in x)
+            # order by the step number in the name, not ctime: copied or
+            # restored checkpoint trees don't preserve ctime, and deleting
+            # by ctime could claim the newest step instead of the oldest
+            oldest = get_oldest(
+                self.ckp_path, qualifier=is_step_ckp, key=step_number
             )
+            if oldest is None:
+                break
+            ckp_to_remove = Path(oldest)
             if os.path.isfile(ckp_to_remove):
                 ckp_to_remove.unlink()
             else:

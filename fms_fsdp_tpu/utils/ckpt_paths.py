@@ -6,6 +6,17 @@ Shared by the model Checkpointer and the dataloader's auto-checkpoint layer.
 import os
 
 
+def is_step_ckp(path) -> bool:
+    """True for the step_<N>_ckp names Checkpointer.save writes."""
+    name = os.path.basename(str(path))
+    return name.startswith("step_") and name.endswith("_ckp")
+
+
+def step_number(path) -> int:
+    """Parse N out of .../step_<N>_ckp."""
+    return int(os.path.basename(str(path)).split("_")[1])
+
+
 def get_latest(targdir, qualifier=lambda x: True, key=os.path.getctime):
     """Full path of the newest qualifying entry in targdir, or None."""
     if os.path.exists(targdir) and len(os.listdir(targdir)) > 0:

@@ -51,6 +51,15 @@ def setup():
         or int(os.environ.get("NUM_PROCESSES", "1")) > 1
         or len([h for h in hostnames.split(",") if h.strip()]) > 1
         or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+        # Slurm launch (scripts/train.slurm): jax.distributed auto-detects
+        # the coordinator/process-index from the Slurm env. SLURM_PROCID
+        # gates on actually being inside an srun step — a bare `python`
+        # inside a multi-task allocation inherits SLURM_NTASKS but is a
+        # single process and must stay single-host.
+        or (
+            "SLURM_PROCID" in os.environ
+            and int(os.environ.get("SLURM_NTASKS", "1")) > 1
+        )
     )
     if multihost:
         jax.distributed.initialize()

@@ -1,0 +1,102 @@
+"""Host data-pipeline throughput benchmark.
+
+Builds a synthetic arrow dataset (~256MB of uint32 tokens), runs the full
+7-layer stateful pipeline exactly as main_training_llama assembles it, and
+reports tokens/sec pulled on the host against per-chip device demand.
+
+Device demand reference points (BENCH_r02): llama3_194m_4k consumes
+~65k tok/s/chip, the 7B-shaped row ~30k tok/s/chip; an 8-chip host
+therefore needs ~0.5M tok/s at the 194m rate. Pass/fail bar per
+VERDICT item 8: host throughput >= 2x device demand per host.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pyarrow as pa
+
+
+def build_dataset(root, n_files=8, docs_per_file=2000, doc_len=1000):
+    schema = pa.schema([pa.field("tokens", pa.uint32())])
+    os.makedirs(os.path.join(root, "dataset_1"), exist_ok=True)
+    rng = np.random.default_rng(0)
+    meta = []
+    for f in range(n_files):
+        path = os.path.join(root, "dataset_1", f"shard_{f}.arrow")
+        with pa.ipc.new_file(path, schema) as w:
+            for _ in range(docs_per_file):
+                doc = rng.integers(0, 32000, size=doc_len, dtype=np.uint32)
+                w.write(pa.record_batch([pa.array(doc)], schema))
+        meta.append(
+            (f"/dataset_1/shard_{f}.arrow", docs_per_file, docs_per_file * doc_len)
+        )
+    os.makedirs(os.path.join(root, "meta"), exist_ok=True)
+    with open(os.path.join(root, "meta", "combined_counts.csv"), "w") as f:
+        f.write("dataset/filename,documents,tokens\n")
+        for name, d, t in meta:
+            f.write(f"{name},{d},{t}\n")
+    return sum(m[2] for m in meta)
+
+
+def main():
+    from fms_fsdp_tpu.config import TrainConfig
+    from fms_fsdp_tpu.data import get_data_loader
+
+    root = "/tmp/bench_loader_data"
+    if not os.path.exists(os.path.join(root, "meta")):
+        total = build_dataset(root)
+        print(f"# built {total/1e6:.0f}M tokens", file=sys.stderr)
+
+    cfg = TrainConfig(
+        data_path=root,
+        datasets="dataset_1",
+        weights="1",
+        seq_length=4096,
+        batch_size=4,
+        vocab_size=32000,
+        bos_token=None,
+        eos_token=0,
+        logical_shards=64,
+        num_workers=int(os.environ.get("BENCH_WORKERS", "1")),
+        ckpt_load_path=os.path.join(root, "_no_ckpt"),
+        resuming_dataset=False,
+    )
+    loader = get_data_loader(cfg, rank=0, world_size=1)
+    it = iter(loader)
+
+    # warmup
+    for _ in range(10):
+        next(it)
+
+    n_batches = 200
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        next(it)
+    dt = time.perf_counter() - t0
+    tok_s = n_batches * cfg.batch_size * cfg.seq_length / dt
+
+    demand_194m = 65_000 * 8  # tok/s, 8-chip host at the 194m rate
+    demand_7b = 30_000 * 8
+    result = {
+        "metric": "host dataloader throughput (arrow pipeline, 1 process)",
+        "tokens_per_sec": round(tok_s),
+        "num_workers": cfg.num_workers,
+        "vs_8chip_194m_demand": round(tok_s / demand_194m, 2),
+        "vs_8chip_7b_demand": round(tok_s / demand_7b, 2),
+    }
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_LOADER.json",
+    )
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -76,6 +76,22 @@ def test_save_load_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_rolling_retention(tmp_path):
+    """max_ckps is enforced over the step_<N>_ckp names save() writes —
+    the newest max_ckps checkpoints survive, oldest are deleted."""
+    cfg = _cfg(ckpt_save_path=str(tmp_path))
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    state, opt = _state(cfg, mesh)
+
+    ck = Checkpointer(str(tmp_path), 2, "fsdp", rank=0)
+    for step in (1, 2, 3, 4):
+        ck.save(step, state, None)
+    kept = sorted(
+        x for x in os.listdir(tmp_path / "checkpoints") if x.startswith("step_")
+    )
+    assert kept == ["step_3_ckp", "step_4_ckp"], kept
+
+
 def test_load_prefers_save_dir(tmp_path):
     """A checkpoint in the save dir (job restart) wins over the load path."""
     cfg = _cfg(ckpt_save_path=str(tmp_path / "save"))
@@ -171,16 +187,18 @@ def test_no_checkpoint_starts_fresh(tmp_path):
     assert step == 0 and ntok == 0 and not resuming
 
 
-def test_tmp_checkpoint_retention(tmp_path):
-    """Only 'tmp'-qualified checkpoints participate in rolling deletion."""
-    ck = Checkpointer(str(tmp_path), 2, "fsdp", rank=0)
-    for i in range(4):
-        d = tmp_path / "checkpoints" / f"step_{i}_tmp_ckp"
+def test_cleanup_ignores_non_step_entries(tmp_path):
+    """Retention only touches step_<N>_ckp entries (ordered by the step
+    number in the name, not ctime); foreign files in the checkpoint
+    folder survive and never shadow real checkpoints on load."""
+    ck = Checkpointer(str(tmp_path), 1, "fsdp", rank=0)
+    (tmp_path / "checkpoints").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "checkpoints" / "notes.txt").write_text("keep me")
+    for i in (30, 10, 20):  # creation order != step order
+        d = tmp_path / "checkpoints" / f"step_{i}_ckp"
         os.makedirs(d)
         (d / "x").write_text("x")
-    keep = tmp_path / "checkpoints" / "step_9_ckp"
-    os.makedirs(keep)
     ck._cleanup()
     left = sorted(os.listdir(tmp_path / "checkpoints"))
-    assert "step_9_ckp" in left
-    assert len([x for x in left if "tmp" in x]) == 3  # oldest tmp removed
+    assert "notes.txt" in left
+    assert [x for x in left if x.startswith("step_")] == ["step_30_ckp"]
