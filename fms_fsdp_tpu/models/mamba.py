@@ -129,7 +129,7 @@ def _constrain(x, spec, mesh):
     return lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
 
 
-def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh):
+def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh, kernel="auto"):
     """x (B, S, D) compute dtype -> (B, S, D)."""
     B, S, d = x.shape
     H, Pd, G, N = cfg.nheads, cfg.headdim, cfg.ngroups, cfg.d_state
@@ -151,7 +151,9 @@ def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh):
     )
     A = -jnp.exp(p["A_log"].astype(jnp.float32))
 
-    y = ssd_scan(xs, dt, A, Bm, Cm, p["D"], chunk_size=cfg.chunk_size)
+    y = ssd_scan(
+        xs, dt, A, Bm, Cm, p["D"], chunk_size=cfg.chunk_size, kernel=kernel
+    )
     y = y.reshape(B, S, d_inner)
 
     # gated RMSNorm: norm(y * silu(z)) (mamba2 norm_before_gate=False)
@@ -210,6 +212,7 @@ def mamba_forward(
     mesh: Optional[Mesh] = None,
     return_hidden: bool = False,
     quant: str = "none",
+    mamba_kernel: str = "auto",
 ):
     """tokens (B, S) int32 -> logits (B, S, padded_vocab) in compute dtype."""
     del scan_layers
@@ -235,7 +238,9 @@ def mamba_forward(
         if is_attn:
             out = _attn_mixer(h, layer["mixer"], cfg, cos, sin, attn_impl, mesh)
         else:
-            out = _mamba_mixer(h, layer["mixer"], cfg, mesh)
+            out = _mamba_mixer(
+                h, layer["mixer"], cfg, mesh, kernel=mamba_kernel
+            )
         residual = residual + out.astype(jnp.float32)
         if "mlp" in layer:
             h = rms_norm(

@@ -31,10 +31,8 @@ from jax import lax
 from jax import shard_map  # jax >= 0.8 API (check_vma kwarg)
 from jax.sharding import PartitionSpec as P
 
-from fms_fsdp_tpu.ops.flash_attention import flash_attention
+from fms_fsdp_tpu.ops.flash_attention import NEG_INF, flash_attention
 from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_TENSOR, DATA_AXES
-
-NEG_INF = -1e30
 
 
 def _einsum_partial(q, k, v, causal, scale):

@@ -11,10 +11,12 @@ The SSD algorithm re-expresses the per-token recurrence
 
 as chunked matmuls: inside a chunk the output is a masked (L, L)
 attention-like product, and only one (P, N) state per head crosses chunk
-boundaries via a short `lax.scan`. This keeps ~all FLOPs in MXU-shaped
-einsums (the reason SSD exists) — XLA maps it well without a custom
-kernel; inter-chunk recurrence is carried in fp32
-(`residual_in_fp32`-style numerics, ref:config_utils.py:181-183).
+boundaries via a short `lax.scan` over chunks (checkpointed body, fp32
+state — `residual_in_fp32`-style numerics, ref:config_utils.py:181-183).
+The intra-chunk hot path has two implementations selected by the
+``kernel`` arg: group-factored XLA einsums (default; also the backward
+for the kernel path) and a Pallas kernel (``"pallas"``) that keeps each
+head's (L, L) decay/score product entirely in VMEM.
 
 Shapes: x (B, S, H, P), dt (B, S, H) (post-softplus), A (H,) negative,
 Bm/Cm (B, S, G, N) with H % G == 0.
@@ -25,6 +27,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fms_fsdp_tpu.ops.flash_attention import NEG_INF
 
 
 def _segsum(a):
@@ -39,11 +45,152 @@ def _segsum(a):
     return jnp.where(mask, diff, -jnp.inf)
 
 
-def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G):
-    """One chunk of the SSD scan. All einsums are *group-factored* — heads
-    are carried as (G, R) with B/C shared across the R axis via dot_general
-    batching, so no head-repeated (L, H, N) or (L, L, H) tensor is ever
-    materialized (the round-1 formulation's memory hog).
+def _intra_kernel(a_ref, dt_ref, x_ref, B_ref, C_ref, y_ref, s_ref, cb_ref, *, R):
+    """Per-(batch, head) intra-chunk SSD: the (L, L) decay/score product
+    lives only in VMEM — the HBM-bound part of the XLA formulation
+    (several passes over a (B, L, L, G, R) fp32 tensor per chunk) becomes
+    two MXU matmuls plus fused elementwise work.
+
+    C@B^T is shared by every head in a GQA group; the grid walks heads
+    fastest, so it is computed once per group into persistent VMEM
+    scratch (``cb_ref``) and reused by the group's other R-1 heads (the
+    B/C input blocks themselves are fetched once per group — their index
+    map is constant across the group)."""
+    L = x_ref.shape[1]
+    h = pl.program_id(1)
+    a = a_ref[0]  # (1, L) fp32
+    dt = dt_ref[0]  # (1, L) fp32
+    x = x_ref[0, :, 0, :]  # (L, P) input dtype
+    B = B_ref[0, :, 0, :]  # (L, N)
+    C = C_ref[0, :, 0, :]  # (L, N)
+
+    cum = jnp.cumsum(a, axis=-1)  # (1, L)
+    cum_col = jnp.transpose(cum)  # (L, 1)
+    seg = cum_col - cum  # (L, L): cum_i - cum_j
+    mask = jax.lax.broadcasted_iota(jnp.int32, (L, L), 0) >= (
+        jax.lax.broadcasted_iota(jnp.int32, (L, L), 1)
+    )
+    decay = jnp.exp(jnp.where(mask, seg, NEG_INF))
+
+    @pl.when(h % R == 0)
+    def _():
+        cb_ref[...] = jax.lax.dot_general(
+            C, B, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (L, L)
+
+    w = cb_ref[...] * decay * dt  # dt broadcasts over rows (j axis)
+    y = jax.lax.dot_general(
+        w.astype(x.dtype), x, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (L, P)
+
+    total = cum[:, L - 1 :]  # (1, 1)
+    r = (jnp.exp(total - cum) * dt).astype(x.dtype)  # (1, L)
+    xs = x * jnp.transpose(r)  # (L, P)
+    s = jax.lax.dot_general(
+        B, xs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # (N, P)
+
+    y_ref[0, :, 0, :] = y
+    s_ref[0, 0] = s
+
+
+def _intra_and_states_xla(xc, dtc, ac, Bc, Cc, G):
+    """Intra-chunk output + chunk state contribution, group-factored XLA
+    einsums (the backward-pass / fallback path)."""
+    Bsz, L, H, P = xc.shape
+    R = H // G
+    N = Bc.shape[-1]
+
+    cum = jnp.cumsum(ac, axis=1)  # (B, L, H)
+    total = cum[:, -1:, :]  # (B, 1, H)
+
+    CB = jnp.einsum(
+        "blgn,bmgn->blmg", Cc, Bc, preferred_element_type=jnp.float32
+    )  # (B, L, L, G) fp32
+    seg = _segsum(jnp.moveaxis(ac.reshape(Bsz, L, G, R), 1, -1))  # (B,G,R,L,L)
+    w = CB[:, :, :, :, None] * jnp.moveaxis(
+        jnp.exp(seg), (1, 2), (3, 4)
+    )  # (B, L, L, G, R) fp32
+    w = w * dtc.reshape(Bsz, 1, L, G, R)
+    y = jnp.einsum(
+        "blmgr,bmgrp->blgrp",
+        w.astype(xc.dtype),
+        xc.reshape(Bsz, L, G, R, P),
+        preferred_element_type=jnp.float32,
+    ).reshape(Bsz, L, H, P)
+
+    r = jnp.exp(total - cum) * dtc  # (B, L, H) fp32
+    xs = r.reshape(Bsz, L, G, R, 1).astype(xc.dtype) * xc.reshape(
+        Bsz, L, G, R, P
+    )
+    states = jnp.einsum(
+        "blgn,blgrp->bgrpn", Bc, xs, preferred_element_type=jnp.float32
+    ).reshape(Bsz, H, P, N)
+    return y, states
+
+
+def _intra_and_states_pallas_fwd(xc, dtc, ac, Bc, Cc, G, interpret):
+    Bsz, L, H, P = xc.shape
+    N = Bc.shape[-1]
+    R = H // G
+    a_rows = jnp.moveaxis(ac, 1, 2)  # (B, H, L)
+    dt_rows = jnp.moveaxis(dtc, 1, 2)
+
+    y, s = pl.pallas_call(
+        functools.partial(_intra_kernel, R=R),
+        grid=(Bsz, H),
+        in_specs=[
+            pl.BlockSpec((1, 1, L), lambda b, h: (b, h, 0)),
+            pl.BlockSpec((1, 1, L), lambda b, h: (b, h, 0)),
+            pl.BlockSpec((1, L, 1, P), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((1, L, 1, N), lambda b, h, R=R: (b, 0, h // R, 0)),
+            pl.BlockSpec((1, L, 1, N), lambda b, h, R=R: (b, 0, h // R, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, L, 1, P), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((1, 1, N, P), lambda b, h: (b, h, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Bsz, L, H, P), jnp.float32),
+            jax.ShapeDtypeStruct((Bsz, H, N, P), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((L, L), jnp.float32)],
+        interpret=interpret,
+    )(a_rows, dt_rows, xc, Bc, Cc)
+    return y, jnp.swapaxes(s, 2, 3)  # states (B, H, P, N)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _intra_and_states_pallas(xc, dtc, ac, Bc, Cc, G, interpret):
+    return _intra_and_states_pallas_fwd(xc, dtc, ac, Bc, Cc, G, interpret)
+
+
+def _intra_pallas_fwd_rule(xc, dtc, ac, Bc, Cc, G, interpret):
+    out = _intra_and_states_pallas_fwd(xc, dtc, ac, Bc, Cc, G, interpret)
+    return out, (xc, dtc, ac, Bc, Cc)
+
+
+def _intra_pallas_bwd_rule(G, interpret, res, cots):
+    # backward recomputes through the XLA formulation — one chunk's
+    # (L, L)-per-head intermediates at a time (the scan body is already
+    # checkpointed), exact same math as the kernel
+    xc, dtc, ac, Bc, Cc = res
+    _, vjp = jax.vjp(
+        lambda *args: _intra_and_states_xla(*args, G), xc, dtc, ac, Bc, Cc
+    )
+    return vjp(cots)
+
+
+_intra_and_states_pallas.defvjp(_intra_pallas_fwd_rule, _intra_pallas_bwd_rule)
+
+
+def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G, kernel="xla"):
+    """One chunk of the SSD scan. The intra-chunk quadratic term and the
+    chunk's state contribution come from either the Pallas kernel (the
+    (L, L)-per-head decay never leaves VMEM) or the group-factored XLA
+    einsums (heads carried as (G, R) dot_general batching — no
+    head-repeated (L, H, N) or (L, L, H) tensor, the round-1 memory hog).
 
     Mixed precision mirrors the mamba_ssm CUDA kernels: matmul operands
     stay in the input dtype (bf16 under training — fp32 MXU matmuls run
@@ -63,23 +210,12 @@ def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G):
     cum = jnp.cumsum(ac, axis=1)  # (B, L, H)
     total = cum[:, -1:, :]  # (B, 1, H)
 
-    # intra-chunk: y_i = sum_{j<=i} (C_i.B_j) exp(cum_i - cum_j) dt_j x_j
-    # grouped: batch dims (b, g); the (L, L) decay is per-head but lives
-    # only as (B, L, L, G, R) here — one chunk at a time under the scan.
-    CB = jnp.einsum(
-        "blgn,bmgn->blmg", Cc, Bc, preferred_element_type=f32
-    )  # (B, L, L, G) fp32
-    seg = _segsum(jnp.moveaxis(ac.reshape(Bsz, L, G, R), 1, -1))  # (B,G,R,L,L)
-    w = CB[:, :, :, :, None] * jnp.moveaxis(
-        jnp.exp(seg), (1, 2), (3, 4)
-    )  # (B, L, L, G, R) fp32
-    w = w * dtc.reshape(Bsz, 1, L, G, R)
-    y = jnp.einsum(
-        "blmgr,bmgrp->blgrp",
-        w.astype(od),
-        xc.reshape(Bsz, L, G, R, P),
-        preferred_element_type=f32,
-    ).reshape(Bsz, L, H, P)
+    if kernel == "pallas":
+        y, states = _intra_and_states_pallas(
+            xc, dtc, ac, Bc, Cc, G, jax.default_backend() == "cpu"
+        )
+    else:
+        y, states = _intra_and_states_xla(xc, dtc, ac, Bc, Cc, G)
 
     # inter-chunk output: exp(cum_i) * C_i . s_prev, grouped over (b, g)
     y = y + (
@@ -92,17 +228,12 @@ def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G):
         )
     ).reshape(Bsz, L, H, P)
 
-    # state update: s_new = exp(total) * s_prev + sum_j r_j dt_j B_j x_j^T
-    r = jnp.exp(total - cum) * dtc  # (B, L, H) fp32
-    xs = r.reshape(Bsz, L, G, R, 1).astype(od) * xc.reshape(Bsz, L, G, R, P)
-    states = jnp.einsum(
-        "blgn,blgrp->bgrpn", Bc, xs, preferred_element_type=f32
-    ).reshape(Bsz, H, P, N)
+    # state update: s_new = exp(total) * s_prev + chunk state contribution
     s_new = jnp.exp(total[:, 0, :])[:, :, None, None] * s_prev + states
     return y, s_new
 
 
-def ssd_scan(x, dt, A, Bm, Cm, D=None, chunk_size: int = 256):
+def ssd_scan(x, dt, A, Bm, Cm, D=None, chunk_size: int = 256, kernel: str = "auto"):
     """Chunked selective scan: ``lax.scan`` over chunks with the fp32
     state carried across chunk boundaries; the chunk body is checkpointed
     so the backward pass recomputes one chunk's (L, L)-per-head
@@ -125,9 +256,14 @@ def ssd_scan(x, dt, A, Bm, Cm, D=None, chunk_size: int = 256):
     Bc = jnp.moveaxis(Bm.reshape(Bsz, C, L, G, N), 1, 0)
     Cc = jnp.moveaxis(Cm.reshape(Bsz, C, L, G, N), 1, 0)
 
+    assert kernel in ("auto", "xla", "pallas"), f"unknown ssd kernel {kernel!r}"
+    # "auto" currently resolves to the XLA formulation; "pallas" runs the
+    # intra-chunk kernel (forward) with the XLA path as its backward
+    mode = "xla" if kernel == "auto" else kernel
+
     @functools.partial(jax.checkpoint, prevent_cse=False)
     def body(s, inp):
-        y_c, s_new = _ssd_chunk(s, *inp, G)
+        y_c, s_new = _ssd_chunk(s, *inp, G, kernel=mode)
         return s_new, y_c
 
     init = jnp.zeros((Bsz, H, P, N), jnp.float32)

@@ -193,6 +193,14 @@ def make_train_step(
     fused = cfg.fused_loss
     chunk = cfg.loss_chunk_size
 
+    from fms_fsdp_tpu.models import MambaConfig
+
+    extra_kwargs = (
+        {"mamba_kernel": cfg.mamba_kernel}
+        if isinstance(model_cfg, MambaConfig)
+        else {}
+    )
+
     def loss_fn(params, inputs, labels):
         out = forward_fn(
             params,
@@ -205,6 +213,7 @@ def make_train_step(
             mesh=mesh,
             return_hidden=fused,
             quant=cfg.quantized_matmuls,
+            **extra_kwargs,
         )
         if fused:
             from fms_fsdp_tpu.ops.fused_ce import fused_linear_cross_entropy
