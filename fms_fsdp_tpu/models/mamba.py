@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from fms_fsdp_tpu.models.configs import MambaConfig
 from fms_fsdp_tpu.ops.attention import attention
 from fms_fsdp_tpu.ops.norms import rms_norm
+from fms_fsdp_tpu.ops.quant import matmul as qmatmul
 from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
 from fms_fsdp_tpu.ops.ssd import causal_conv1d, ssd_scan
 from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_FSDP, AXIS_TENSOR, DATA_AXES
@@ -129,13 +130,13 @@ def _constrain(x, spec, mesh):
     return lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
 
 
-def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh, kernel="auto"):
+def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh, kernel="auto", quant="none"):
     """x (B, S, D) compute dtype -> (B, S, D)."""
     B, S, d = x.shape
     H, Pd, G, N = cfg.nheads, cfg.headdim, cfg.ngroups, cfg.d_state
     d_inner = cfg.d_inner
 
-    zxbcdt = x @ p["in_proj"]
+    zxbcdt = qmatmul(x, p["in_proj"], quant=quant)
     zxbcdt = _constrain(zxbcdt, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
     z = zxbcdt[..., :d_inner]
     xBC = zxbcdt[..., d_inner : d_inner + _conv_dim(cfg)]
@@ -158,17 +159,17 @@ def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh, kernel="auto"):
 
     # gated RMSNorm: norm(y * silu(z)) (mamba2 norm_before_gate=False)
     y = rms_norm(y * jax.nn.silu(z), p["norm"], cfg.norm_eps)
-    out = y @ p["out_proj"]
+    out = qmatmul(y, p["out_proj"], quant=quant)
     return _constrain(out, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 
 
-def _attn_mixer(x, p: Params, cfg: MambaConfig, cos, sin, attn_impl, mesh):
+def _attn_mixer(x, p: Params, cfg: MambaConfig, cos, sin, attn_impl, mesh, quant="none"):
     B, S, d = x.shape
     a = cfg.attn_cfg
     hd = a.head_dim
-    q = (x @ p["wq"]).reshape(B, S, a.num_heads, hd)
-    k = (x @ p["wk"]).reshape(B, S, a.num_heads_kv, hd)
-    v = (x @ p["wv"]).reshape(B, S, a.num_heads_kv, hd)
+    q = qmatmul(x, p["wq"], quant=quant).reshape(B, S, a.num_heads, hd)
+    k = qmatmul(x, p["wk"], quant=quant).reshape(B, S, a.num_heads_kv, hd)
+    v = qmatmul(x, p["wv"], quant=quant).reshape(B, S, a.num_heads_kv, hd)
 
     # partial rotary: first rotary_emb_dim dims of each head
     r = a.rotary_emb_dim
@@ -189,15 +190,17 @@ def _attn_mixer(x, p: Params, cfg: MambaConfig, cos, sin, attn_impl, mesh):
         o = ring_attention(q, k, v, mesh, causal=a.causal)
     else:
         o = attention(q, k, v, causal=a.causal, impl=attn_impl)
-    o = o.reshape(B, S, a.num_heads * hd) @ p["wo"]
+    o = qmatmul(o.reshape(B, S, a.num_heads * hd), p["wo"], quant=quant)
     return _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 
 
-def _mlp(x, p: Params, mesh):
-    gate = jax.nn.silu(x @ p["w1"])
-    up = x @ p["w3"]
+def _mlp(x, p: Params, mesh, quant="none"):
+    gate = jax.nn.silu(qmatmul(x, p["w1"], quant=quant))
+    up = qmatmul(x, p["w3"], quant=quant)
     h = _constrain(gate * up, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
-    return _constrain(h @ p["w2"], P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    return _constrain(
+        qmatmul(h, p["w2"], quant=quant), P(DATA_AXES, AXIS_CONTEXT, None), mesh
+    )
 
 
 def mamba_forward(
@@ -216,11 +219,6 @@ def mamba_forward(
 ):
     """tokens (B, S) int32 -> logits (B, S, padded_vocab) in compute dtype."""
     del scan_layers
-    if quant != "none":
-        raise ValueError(
-            "quantized_matmuls is Llama-only for now; got "
-            f"{quant!r} on a Mamba config"
-        )
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     n_layer = len(params["layers"])
     ac_mask = ac_mask if ac_mask is not None else [False] * n_layer
@@ -236,17 +234,21 @@ def mamba_forward(
     def block(residual, layer, is_attn):
         h = rms_norm(residual.astype(compute_dtype), layer["norm"], cfg.norm_eps)
         if is_attn:
-            out = _attn_mixer(h, layer["mixer"], cfg, cos, sin, attn_impl, mesh)
+            out = _attn_mixer(
+                h, layer["mixer"], cfg, cos, sin, attn_impl, mesh, quant=quant
+            )
         else:
             out = _mamba_mixer(
-                h, layer["mixer"], cfg, mesh, kernel=mamba_kernel
+                h, layer["mixer"], cfg, mesh, kernel=mamba_kernel, quant=quant
             )
         residual = residual + out.astype(jnp.float32)
         if "mlp" in layer:
             h = rms_norm(
                 residual.astype(compute_dtype), layer["norm2"], cfg.norm_eps
             )
-            residual = residual + _mlp(h, layer["mlp"], mesh).astype(jnp.float32)
+            residual = residual + _mlp(
+                h, layer["mlp"], mesh, quant=quant
+            ).astype(jnp.float32)
         return residual
 
     for i, layer in enumerate(params["layers"]):

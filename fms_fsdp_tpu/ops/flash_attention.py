@@ -314,25 +314,14 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc[...]
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout, dlse=None):
-    """Backward for o (and optionally the lse output).
-
-    A differentiable lse output only shifts the per-row delta: the lse
-    cotangent enters as ds_ij += p_ij * dlse_i, and ds is already
-    p * (dp - delta), so delta_eff = delta - dlse — zero kernel changes.
-    """
-    q, k, v, o, lse = residuals
+def flash_dq(q, k, v, dout, lse, delta, *, scale, causal, block_q, block_k, interpret):
+    """dq of one attention partial, (B, N, S, H) layout. ``lse``/``delta``
+    are the (global) softmax stats of the queries, (B, N, S, 1) fp32 —
+    callable per ring step with stats from the full softmax."""
     batch, nq, seq_q, head = q.shape
     nkv, seq_k = k.shape[1], k.shape[2]
     group = nq // nkv
-
-    delta = jnp.sum(
-        o.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1, keepdims=True
-    )
-    if dlse is not None:
-        delta = delta - dlse.astype(jnp.float32)
-
-    dq = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block_k=block_k, causal=causal),
         grid=(batch, nq, seq_q // block_q),
         in_specs=[
@@ -348,6 +337,13 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout, dlse
         interpret=interpret,
     )(q, k, v, dout, lse, delta)
 
+
+def flash_dkv(q, k, v, dout, lse, delta, *, scale, causal, block_q, block_k, interpret):
+    """(dk, dv) of one attention partial, (B, N, S, H) layout, fp32
+    outputs. Stats as in flash_dq."""
+    batch, nq, seq_q, head = q.shape
+    nkv, seq_k = k.shape[1], k.shape[2]
+    group = nq // nkv
     # row-layout stats for the transposed dk/dv kernel: (B, N, 1, S)
     lse_rows = jnp.swapaxes(lse, 2, 3)
     delta_rows = jnp.swapaxes(delta, 2, 3)
@@ -414,7 +410,29 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout, dlse
         ],
         interpret=interpret,
     )(q, k, v, dout, lse_rows, delta_rows)
+    return dk, dv
 
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout, dlse=None):
+    """Backward for o (and optionally the lse output).
+
+    A differentiable lse output only shifts the per-row delta: the lse
+    cotangent enters as ds_ij += p_ij * dlse_i, and ds is already
+    p * (dp - delta), so delta_eff = delta - dlse — zero kernel changes.
+    """
+    q, k, v, o, lse = residuals
+    delta = jnp.sum(
+        o.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+
+    kw = dict(
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    dq = flash_dq(q, k, v, dout, lse, delta, **kw)
+    dk, dv = flash_dkv(q, k, v, dout, lse, delta, **kw)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 

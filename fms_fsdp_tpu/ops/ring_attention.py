@@ -18,9 +18,15 @@ merge exactly through lse:
     lse' = logaddexp(lse_a, lse_b)
     o'   = o_a * exp(lse_a - lse') + o_b * exp(lse_b - lse')
 
-Composes with GQA and the tensor axis (heads split by shard_map), and is
-differentiable end-to-end (the lse output carries its own cotangent,
-folded into the flash backward's delta).
+The backward is a ring of its own (custom VJP): residuals are only the
+LOCAL q/k/v/out/lse chunks — O(S/cp) per device — and the kv chunks are
+re-streamed around the ring with their dk/dv accumulators traveling
+alongside, so after cp steps every chunk arrives home fully accumulated.
+Per-step partial gradients use the flash dq/dkv kernels with the global
+softmax stats (the FlashAttention decomposition makes partial gradients
+exact given global lse/delta).
+
+Composes with GQA and the tensor axis (heads split by shard_map).
 """
 
 import functools
@@ -31,7 +37,13 @@ from jax import lax
 from jax import shard_map  # jax >= 0.8 API (check_vma kwarg)
 from jax.sharding import PartitionSpec as P
 
-from fms_fsdp_tpu.ops.flash_attention import NEG_INF, flash_attention
+from fms_fsdp_tpu.ops.flash_attention import (
+    NEG_INF,
+    _pick_block,
+    flash_attention,
+    flash_dkv,
+    flash_dq,
+)
 from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_TENSOR, DATA_AXES
 
 
@@ -67,6 +79,41 @@ def _einsum_partial(q, k, v, causal, scale):
     return o, lse
 
 
+def _einsum_partial_grads(q, k, v, do, lse, delta, causal, scale):
+    """Small-shape fallback gradients of one partial given global stats.
+    Returns (dq, dk, dv) in fp32, (B, S, N, H) layouts."""
+    b, sq, nq, h = q.shape
+    sk, nkv = k.shape[1], k.shape[2]
+    group = nq // nkv
+    qg = q.reshape(b, sq, nkv, group, h)
+    dog = do.astype(jnp.float32).reshape(b, sq, nkv, group, h)
+    s = (
+        jnp.einsum(
+            "bqkgh,bskh->bkgqs", qg, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
+    if causal:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    stats = lambda t: jnp.moveaxis(  # noqa: E731  (b,sq,nq,1)->(b,nkv,g,sq,1)
+        t.reshape(b, sq, nkv, group, 1), 1, 3
+    )
+    p = jnp.exp(s - stats(lse))  # (b, nkv, g, sq, sk) via (...,sq,1) bcast
+    dp = jnp.einsum(
+        "bqkgh,bskh->bkgqs", dog, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - stats(delta)) * scale
+    dq = jnp.einsum(
+        "bkgqs,bskh->bqkgh", ds, k.astype(jnp.float32)
+    ).reshape(b, sq, nq, h)
+    dk = jnp.einsum("bkgqs,bqkgh->bskh", ds, qg.astype(jnp.float32))
+    dv = jnp.einsum("bkgqs,bqkgh->bskh", p, dog)
+    return dq, dk, dv
+
+
 def _flash_eligible(q_shape, kv_shape, cp: int) -> bool:
     """Local-chunk eligibility for the Pallas partials: the kernel's own
     supports() gate at the per-device shapes, on a backend that can run it
@@ -80,6 +127,10 @@ def _flash_eligible(q_shape, kv_shape, cp: int) -> bool:
         "tpu",
         "cpu",
     )
+
+
+def _bnsh(*arrs):
+    return tuple(jnp.swapaxes(a, 1, 2) for a in arrs)
 
 
 def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
@@ -107,6 +158,9 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
 
     use_flash = _flash_eligible(q.shape, k.shape, cp)
     interpret = jax.default_backend() == "cpu"
+    s_local = q.shape[1] // cp
+    bq = _pick_block(s_local, 512)
+    bk = _pick_block(s_local, 512)
 
     def partial_fn(q_loc, k_cur, v_cur, diag: bool):
         if use_flash:
@@ -121,14 +175,38 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
             )
         return _einsum_partial(q_loc, k_cur, v_cur, diag, scale)
 
+    def partial_grads(qpack, k_cur, v_cur, diag: bool):
+        if use_flash:
+            # qpack carries the loop-invariant (B,N,S,H)-layout q/do/stats,
+            # transposed ONCE outside the ring loop
+            qt, dot, lset, deltat = qpack
+            kt, vt = _bnsh(k_cur, v_cur)
+            kw = dict(
+                scale=scale, causal=diag, block_q=bq, block_k=bk,
+                interpret=interpret,
+            )
+            dq = flash_dq(qt, kt, vt, dot, lset, deltat, **kw)
+            dk, dv = flash_dkv(qt, kt, vt, dot, lset, deltat, **kw)
+            return (
+                jnp.swapaxes(dq, 1, 2).astype(jnp.float32),
+                jnp.swapaxes(dk, 1, 2),
+                jnp.swapaxes(dv, 1, 2),
+            )
+        q_loc, do, lse, delta = qpack
+        return _einsum_partial_grads(
+            q_loc, k_cur, v_cur, do, lse, delta, diag, scale
+        )
+
+    lse_spec = P(spec_q[0], AXIS_CONTEXT, spec_q[2], None)
+
     @functools.partial(
         shard_map,
         mesh=mesh,
         in_specs=(spec_q, spec_kv, spec_kv),
-        out_specs=spec_q,
+        out_specs=(spec_q, lse_spec),
         check_vma=False,
     )
-    def inner(q, k, v):
+    def fwd_inner(q, k, v):
         idx = lax.axis_index(AXIS_CONTEXT)
         b, s_loc, nq, h = q.shape
 
@@ -174,7 +252,84 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
 
         acc = jnp.zeros((b, s_loc, nq, h), jnp.float32)
         lse0 = jnp.full((b, s_loc, nq, 1), NEG_INF, jnp.float32)
-        acc, _, _, _ = lax.fori_loop(0, cp, body, (acc, lse0, k, v))
-        return acc.astype(q.dtype)
+        acc, lse, _, _ = lax.fori_loop(0, cp, body, (acc, lse0, k, v))
+        return acc.astype(q.dtype), lse
 
-    return inner(q, k, v)
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec_q, spec_kv, spec_kv, spec_q, lse_spec, spec_q),
+        out_specs=(spec_q, spec_kv, spec_kv),
+        check_vma=False,
+    )
+    def bwd_inner(q, k, v, out, lse, do):
+        idx = lax.axis_index(AXIS_CONTEXT)
+        delta = jnp.sum(
+            out.astype(jnp.float32) * do.astype(jnp.float32),
+            axis=-1,
+            keepdims=True,
+        )
+        # loop-invariant layouts: transpose once, not per ring step (XLA
+        # does not hoist out of lax.cond branches)
+        if use_flash:
+            qpack = _bnsh(q, do) + _bnsh(lse, delta)
+        else:
+            qpack = (q, do, lse, delta)
+
+        def body(step, carry):
+            dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+            src = (idx - step) % cp
+
+            def diag(_):
+                return partial_grads(qpack, k_cur, v_cur, True)
+
+            def visible(_):
+                return partial_grads(qpack, k_cur, v_cur, False)
+
+            def masked(_):
+                return (
+                    jnp.zeros_like(dq_acc),
+                    jnp.zeros_like(dk_cur),
+                    jnp.zeros_like(dv_cur),
+                )
+
+            if causal:
+                dq_p, dk_p, dv_p = lax.cond(
+                    src == idx,
+                    diag,
+                    lambda _: lax.cond(src < idx, visible, masked, None),
+                    None,
+                )
+            else:
+                dq_p, dk_p, dv_p = visible(None)
+
+            dq_acc = dq_acc + dq_p
+            # dk/dv accumulators travel WITH their kv chunk: after cp
+            # rotations both are home, fully accumulated
+            dk_cur = lax.ppermute(dk_cur + dk_p, AXIS_CONTEXT, perm)
+            dv_cur = lax.ppermute(dv_cur + dv_p, AXIS_CONTEXT, perm)
+            k_cur = lax.ppermute(k_cur, AXIS_CONTEXT, perm)
+            v_cur = lax.ppermute(v_cur, AXIS_CONTEXT, perm)
+            return dq_acc, k_cur, v_cur, dk_cur, dv_cur
+
+        dq0 = jnp.zeros(q.shape, jnp.float32)
+        dkv0 = jnp.zeros(k.shape, jnp.float32)
+        dq, _, _, dk, dv = lax.fori_loop(
+            0, cp, body, (dq0, k, v, dkv0, jnp.zeros_like(dkv0))
+        )
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    @jax.custom_vjp
+    def ring(q, k, v):
+        out, _ = fwd_inner(q, k, v)
+        return out
+
+    def ring_fwd(q, k, v):
+        out, lse = fwd_inner(q, k, v)
+        return out, (q, k, v, out, lse)
+
+    def ring_bwd(res, do):
+        return bwd_inner(*res, do)
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring(q, k, v)

@@ -30,6 +30,42 @@ def test_flash_matches_xla(nq, nkv, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_flash_return_lse_differentiable():
+    """flash_attention(return_lse=True): both outputs carry gradients —
+    the lse cotangent folds into the backward's delta (delta - dlse)."""
+    q, k, v = _rand_qkv(1, 256, 4, 2, 128, seed=7)
+
+    def f_loss(q, k, v):
+        o, lse = flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128,
+            interpret=True, return_lse=True,
+        )
+        return (o**2).mean() + (lse**2).mean()
+
+    # reference: explicit softmax attention + logsumexp
+    def ref_loss(q, k, v):
+        b, s, nq, h = q.shape
+        nkv = k.shape[2]
+        qg = q.reshape(b, s, nkv, nq // nkv, h)
+        scores = (
+            jnp.einsum("bqkgh,bskh->bkgqs", qg, k).astype(jnp.float32)
+            * h**-0.5
+        )
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+        lse = jax.scipy.special.logsumexp(scores, axis=-1)  # (b,nkv,g,q)
+        p = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bkgqs,bskh->bkgqh", p, v)
+        o = jnp.moveaxis(o, 3, 1).reshape(b, s, nq, h)
+        lse = jnp.moveaxis(lse, 3, 1).reshape(b, s, nq, 1)
+        return (o**2).mean() + (lse**2).mean()
+
+    gf = jax.grad(f_loss, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
 def test_flash_grads_cross_length_causal():
     """seq_k > seq_q, causal: k-blocks wholly past the q sequence must get
     zero dk/dv (regression: stale-scratch write in the streamed-q kernel)."""

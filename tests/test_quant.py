@@ -67,6 +67,51 @@ def test_dispatch(quant):
     assert out.shape == (2, 64, 128)
 
 
+def test_mamba_train_step_with_int8():
+    """One hybrid-Mamba train step with quantized matmuls: finite loss."""
+    from fms_fsdp_tpu.config import TrainConfig
+    from fms_fsdp_tpu.models.configs import MambaAttnConfig, MambaConfig
+    from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+    from fms_fsdp_tpu.train.step import (
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    cfg = TrainConfig(
+        sharding_strategy="fsdp",
+        batch_size=1,
+        seq_length=32,
+        num_steps=10,
+        quantized_matmuls="int8_dgrad",
+        attention_kernel="xla",
+    )
+    model_cfg = MambaConfig(
+        d_model=64,
+        d_intermediate=128,
+        n_layer=2,
+        vocab_size=128,
+        attn_layer_idx=(1,),
+        attn_cfg=MambaAttnConfig(
+            head_dim=16, num_heads=4, num_heads_kv=2, rotary_emb_dim=8
+        ),
+        d_state=16,
+        headdim=16,
+        chunk_size=16,
+        pad_vocab_size_multiple=16,
+    )
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    opt = make_optimizer(cfg)
+    state, _ = init_train_state(jax.random.PRNGKey(0), model_cfg, cfg, mesh, opt)
+    step_fn = make_train_step(model_cfg, cfg, mesh, opt)
+    n_dp = mesh.shape["replica"] * mesh.shape["fsdp"]
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (n_dp, 33), 0, 128, dtype=jnp.int32
+    )
+    state, metrics = step_fn(state, (tokens[:, :-1], tokens[:, 1:]))
+    assert bool(jnp.isfinite(metrics["loss"]))
+
+
 def test_train_step_with_int8():
     """One llama train step with quantized_matmuls on: finite loss/grads."""
     from fms_fsdp_tpu.config import TrainConfig

@@ -75,8 +75,8 @@ def test_ring_flash_path_matches_full(causal):
 
 
 def test_ring_flash_path_grads():
-    """Gradients through the flash-partial path — exercises the lse
-    cotangent folding in the flash backward."""
+    """Gradients through the flash-partial path via the ring-level custom
+    VJP (O(S/cp) residuals; kv re-streamed in the backward ring)."""
     mesh = build_mesh(
         MeshConfig(sharding_strategy="fsdp", context_parallel_size=2)
     )
