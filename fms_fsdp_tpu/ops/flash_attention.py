@@ -314,10 +314,15 @@ def _dkv_kernel(
         dv_ref[0, 0] = dv_acc[...]
 
 
-def flash_dq(q, k, v, dout, lse, delta, *, scale, causal, block_q, block_k, interpret):
+def flash_dq(
+    q, k, v, dout, lse, delta, *, scale, causal, block_q, block_k, interpret,
+    out_dtype=None,
+):
     """dq of one attention partial, (B, N, S, H) layout. ``lse``/``delta``
     are the (global) softmax stats of the queries, (B, N, S, 1) fp32 —
-    callable per ring step with stats from the full softmax."""
+    callable per ring step with stats from the full softmax. ``out_dtype``
+    (default q.dtype) should be fp32 when partials are accumulated across
+    ring steps, so per-step rounding doesn't compound."""
     batch, nq, seq_q, head = q.shape
     nkv, seq_k = k.shape[1], k.shape[2]
     group = nq // nkv
@@ -333,7 +338,7 @@ def flash_dq(q, k, v, dout, lse, delta, *, scale, causal, block_q, block_k, inte
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, head), lambda b, h, i: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
         interpret=interpret,
     )(q, k, v, dout, lse, delta)
 

@@ -47,14 +47,12 @@ from fms_fsdp_tpu.ops.flash_attention import (
 from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_TENSOR, DATA_AXES
 
 
-def _einsum_partial(q, k, v, causal, scale):
-    """Small-shape fallback: (o_norm, lse) via a materialized score matrix.
-
-    causal here means the *diagonal* chunk relation (q and k share global
-    offsets); fully-visible chunks pass causal=False.
-    """
+def _scores(q, k, causal, scale):
+    """(grouped q, scores) for the einsum fallback: scores
+    (b, nkv, group, sq, sk) fp32, causal-masked for the diagonal chunk
+    relation (fully-visible chunks pass causal=False)."""
     b, sq, nq, h = q.shape
-    nkv = k.shape[2]
+    sk, nkv = k.shape[1], k.shape[2]
     group = nq // nkv
     qg = q.reshape(b, sq, nkv, group, h)
     s = (
@@ -64,9 +62,17 @@ def _einsum_partial(q, k, v, causal, scale):
         * scale
     )
     if causal:
-        qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 1)
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         s = jnp.where(qpos >= kpos, s, NEG_INF)
+    return qg, s
+
+
+def _einsum_partial(q, k, v, causal, scale):
+    """Small-shape fallback: (o_norm, lse) via a materialized score matrix."""
+    b, sq, nq, h = q.shape
+    _, s = _scores(q, k, causal, scale)
+    nkv = k.shape[2]
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -83,20 +89,10 @@ def _einsum_partial_grads(q, k, v, do, lse, delta, causal, scale):
     """Small-shape fallback gradients of one partial given global stats.
     Returns (dq, dk, dv) in fp32, (B, S, N, H) layouts."""
     b, sq, nq, h = q.shape
-    sk, nkv = k.shape[1], k.shape[2]
+    nkv = k.shape[2]
     group = nq // nkv
-    qg = q.reshape(b, sq, nkv, group, h)
+    qg, s = _scores(q, k, causal, scale)
     dog = do.astype(jnp.float32).reshape(b, sq, nkv, group, h)
-    s = (
-        jnp.einsum(
-            "bqkgh,bskh->bkgqs", qg, k, preferred_element_type=jnp.float32
-        )
-        * scale
-    )
-    if causal:
-        qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
     stats = lambda t: jnp.moveaxis(  # noqa: E731  (b,sq,nq,1)->(b,nkv,g,sq,1)
         t.reshape(b, sq, nkv, group, 1), 1, 3
     )
@@ -185,10 +181,14 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
                 scale=scale, causal=diag, block_q=bq, block_k=bk,
                 interpret=interpret,
             )
-            dq = flash_dq(qt, kt, vt, dot, lset, deltat, **kw)
+            # dq partials accumulate across ring steps: keep them fp32 so
+            # per-step rounding doesn't compound
+            dq = flash_dq(
+                qt, kt, vt, dot, lset, deltat, out_dtype=jnp.float32, **kw
+            )
             dk, dv = flash_dkv(qt, kt, vt, dot, lset, deltat, **kw)
             return (
-                jnp.swapaxes(dq, 1, 2).astype(jnp.float32),
+                jnp.swapaxes(dq, 1, 2),
                 jnp.swapaxes(dk, 1, 2),
                 jnp.swapaxes(dv, 1, 2),
             )

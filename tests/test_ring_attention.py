@@ -47,16 +47,21 @@ def test_ring_with_tensor_axis():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_ring_grads():
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_grads(causal):
     mesh = build_mesh(
         MeshConfig(sharding_strategy="fsdp", context_parallel_size=2)
     )
     q, k, v = _qkv(1, 32, 2, 2, 16, seed=2)
 
-    g1 = jax.grad(lambda q, k, v: (ring_attention(q, k, v, mesh) ** 2).mean(),
-                  argnums=(0, 1, 2))(q, k, v)
-    g2 = jax.grad(lambda q, k, v: (xla_attention(q, k, v) ** 2).mean(),
-                  argnums=(0, 1, 2))(q, k, v)
+    g1 = jax.grad(
+        lambda q, k, v: (ring_attention(q, k, v, mesh, causal=causal) ** 2).mean(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: (xla_attention(q, k, v, causal=causal) ** 2).mean(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
@@ -74,7 +79,8 @@ def test_ring_flash_path_matches_full(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
 
 
-def test_ring_flash_path_grads():
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_path_grads(causal):
     """Gradients through the flash-partial path via the ring-level custom
     VJP (O(S/cp) residuals; kv re-streamed in the backward ring)."""
     mesh = build_mesh(
@@ -82,10 +88,14 @@ def test_ring_flash_path_grads():
     )
     q, k, v = _qkv(1, 512, 2, 1, 128, seed=4)  # nq=2/nkv=1: GQA group sweep
 
-    g1 = jax.grad(lambda q, k, v: (ring_attention(q, k, v, mesh) ** 2).mean(),
-                  argnums=(0, 1, 2))(q, k, v)
-    g2 = jax.grad(lambda q, k, v: (xla_attention(q, k, v) ** 2).mean(),
-                  argnums=(0, 1, 2))(q, k, v)
+    g1 = jax.grad(
+        lambda q, k, v: (ring_attention(q, k, v, mesh, causal=causal) ** 2).mean(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: (xla_attention(q, k, v, causal=causal) ** 2).mean(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
 
