@@ -86,6 +86,10 @@ def make_stage1_step(
     base_api = base_api or get_base_api("embedllama")
     n_predict = scfg.n_predict
     schedule = get_speculator_lr_schedule(cfg)
+    # int8 base forward: the frozen teacher's GEMMs can run on the MXU
+    # int8 path too — Llama bases only (the other archs would silently
+    # ignore the flag through their **_unused kwargs)
+    quant = cfg.quantized_matmuls if base_api.arch == "llama" else "none"
 
     def loss_fn(spec_params, inputs):
         _, embeds = base_api.forward_embeds(
@@ -93,6 +97,7 @@ def make_stage1_step(
             inputs[:, : -n_predict - 1],
             model_cfg,
             attn_impl=cfg.attention_kernel,
+            quant=quant,
         )
         embeds = jax.lax.stop_gradient(embeds)
         preds = speculator_forward(spec_params, embeds, inputs[:, 1:], scfg)
