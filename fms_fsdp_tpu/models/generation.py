@@ -90,31 +90,48 @@ def prefill(
     return logits, embeds, {"k": k_cache, "v": v_cache}
 
 
-def decode_step(params, cache, token, pos, cfg: LlamaConfig, compute_dtype=jnp.bfloat16):
-    """One cached decode step. token (B, 1) int32 at position ``pos``.
-
-    Returns (logits (B, V), embeds (B, D), updated cache)."""
+def decode_chunk(params, cache, tokens, pos, cfg: LlamaConfig, compute_dtype=jnp.bfloat16):
+    """Cached decode of m tokens at positions pos..pos+m-1 in one forward
+    (the verification step of speculative decoding; decode_step is the
+    m=1 case). Returns (logits (B, m, V), embeds (B, m, D), cache)."""
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
-    b = token.shape[0]
+    b, m = tokens.shape
     hd, nkv = cfg.head_dim, cfg.n_kv_heads
     max_seq = cache["k"].shape[2]
 
     cos, sin = rope_table(max_seq, hd, cfg.rope_theta)
-    positions = jnp.full((b, 1), pos, jnp.int32)
-    x = params["embedding"][token]  # (B, 1, D)
+    positions = pos + jnp.arange(m, dtype=jnp.int32)[None, :]  # (1, m)
+    positions = jnp.broadcast_to(positions, (b, m))
+    x = params["embedding"][tokens]
+
+    def attend(q, k_cache, v_cache):
+        # q position pos+i sees cache entries <= pos+i
+        nq = cfg.nheads
+        group = nq // nkv
+        s = k_cache.shape[1]
+        qg = q.reshape(b, m, nkv, group, hd)
+        scores = jnp.einsum(
+            "bmkgh,bskh->bkgms", qg, k_cache, preferred_element_type=jnp.float32
+        ) * (hd**-0.5)
+        idx = jnp.arange(s)[None, None, None, None, :]
+        qpos = positions[:, None, None, :, None]
+        scores = jnp.where(idx <= qpos, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bkgms,bskh->bmkgh", probs, v_cache)
+        return out.reshape(b, m, nq * hd)
 
     def body(x, inp):
         layer, k_cache, v_cache = inp
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ layer["wq"]).reshape(b, 1, cfg.nheads, hd)
-        k = (h @ layer["wk"]).reshape(b, 1, nkv, hd)
-        v = (h @ layer["wv"]).reshape(b, 1, nkv, hd)
+        q = (h @ layer["wq"]).reshape(b, m, cfg.nheads, hd)
+        k = (h @ layer["wk"]).reshape(b, m, nkv, hd)
+        v = (h @ layer["wv"]).reshape(b, m, nkv, hd)
         q = apply_rotary(q, cos, sin, positions)
         k = apply_rotary(k, cos, sin, positions)
         k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-        o = _decode_attention(q, k_cache, v_cache, pos)
-        x = x + o.reshape(b, 1, cfg.nheads * hd) @ layer["wo"]
+        o = attend(q, k_cache, v_cache)
+        x = x + o @ layer["wo"]
         h2 = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
         ffn = (jax.nn.silu(h2 @ layer["w1"]) * (h2 @ layer["w3"])) @ layer["w2"]
         return x + ffn, (k_cache, v_cache)
@@ -124,7 +141,17 @@ def decode_step(params, cache, token, pos, cfg: LlamaConfig, compute_dtype=jnp.b
     )
     embeds = rms_norm(x, params["norm"], cfg.norm_eps)
     logits = embeds @ params["lm_head"]
-    return logits[:, 0], embeds[:, 0], {"k": k_cache, "v": v_cache}
+    return logits, embeds, {"k": k_cache, "v": v_cache}
+
+
+def decode_step(params, cache, token, pos, cfg: LlamaConfig, compute_dtype=jnp.bfloat16):
+    """One cached decode step. token (B, 1) int32 at position ``pos``.
+    Returns (logits (B, V), embeds (B, D), updated cache) — the m=1 case
+    of decode_chunk."""
+    logits, embeds, cache = decode_chunk(
+        params, cache, token, pos, cfg, compute_dtype
+    )
+    return logits[:, 0], embeds[:, 0], cache
 
 
 def _sample(logits, key, temperature, top_k, do_sample):

@@ -1,0 +1,98 @@
+"""Speculative decoding tests: greedy equivalence with plain generation
+(the correctness invariant of speculative decoding), chunked cached
+decode parity, and proposal chaining."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fms_fsdp_tpu.models.configs import LlamaConfig
+from fms_fsdp_tpu.models.generation import generate, prefill
+from fms_fsdp_tpu.models.llama import init_llama_params
+from fms_fsdp_tpu.models.speculative import (
+    decode_chunk,
+    speculative_decode,
+    speculator_propose,
+)
+from fms_fsdp_tpu.models.speculator import (
+    SpeculatorConfig,
+    init_speculator_params,
+    speculator_forward,
+)
+
+CFG = LlamaConfig(
+    src_vocab_size=128,
+    emb_dim=64,
+    nheads=4,
+    kvheads=2,
+    nlayers=2,
+    multiple_of=16,
+    max_expected_seq_len=128,
+)
+SCFG = SpeculatorConfig(
+    emb_dim=64, inner_dim=32, vocab_size=128, n_predict=3
+)
+
+
+def _models(seed=0):
+    base = init_llama_params(jax.random.PRNGKey(seed), CFG)
+    spec = init_speculator_params(jax.random.PRNGKey(seed + 1), SCFG)
+    return base, spec
+
+
+def test_decode_chunk_matches_prefill():
+    """Chunked cached decode at positions P..P+m-1 reproduces the full
+    uncached forward's logits at those positions."""
+    base, _ = _models()
+    toks = jax.random.randint(jax.random.PRNGKey(2), (1, 24), 0, 128)
+    plen, m = 16, 8
+
+    logits_full, _, _ = prefill(base, toks, CFG, max_seq_len=64, full_logits=True)
+    _, _, cache = prefill(base, toks[:, :plen], CFG, max_seq_len=64)
+    logits_chunk, _, _ = decode_chunk(base, cache, toks[:, plen:], plen, CFG)
+    np.testing.assert_allclose(
+        np.asarray(logits_chunk),
+        np.asarray(logits_full[:, plen:]),
+        atol=2e-2,  # bf16 forward
+    )
+
+
+def test_propose_matches_teacher_forced_heads():
+    """The greedy chain equals teacher-forcing speculator_forward with the
+    chain's own picks as inds."""
+    base, spec = _models()
+    toks = jax.random.randint(jax.random.PRNGKey(3), (1, 8), 0, 128)
+    _, embeds, _ = prefill(base, toks, CFG, max_seq_len=32)
+    last = toks[:, -1].astype(jnp.int32)
+
+    props = speculator_propose(spec, embeds[:, -1], last, SCFG)
+    inds = jnp.concatenate([last[:, None], props[:, :-1]], axis=1)
+    # head i fed with inds[:, i] (N=1): logits (n, B, 1, V)
+    preds = speculator_forward(spec, embeds[:, -1:][:, :1, :], inds, SCFG)
+    chained = jnp.argmax(preds[:, 0, 0], axis=-1)
+    np.testing.assert_array_equal(np.asarray(props[0]), np.asarray(chained))
+
+
+def test_speculative_matches_plain_greedy():
+    """Token-for-token equivalence with plain greedy decoding — the
+    speculative-decoding correctness invariant."""
+    base, spec = _models(seed=5)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (1, 12), 0, 128)
+
+    plain = generate(
+        base,
+        prompt,
+        CFG,
+        key=jax.random.PRNGKey(0),
+        max_seq_len=96,
+        max_new_tokens=24,
+        do_sample=False,
+        include_embeds=False,
+    )
+    result = speculative_decode(
+        base, spec, prompt, CFG, SCFG, max_seq_len=96, max_new_tokens=24
+    )
+    np.testing.assert_array_equal(
+        np.asarray(result["tokens"]), np.asarray(plain)
+    )
+    assert 0.0 <= result["accept_rate"] <= SCFG.n_predict
