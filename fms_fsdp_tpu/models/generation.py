@@ -25,23 +25,6 @@ from fms_fsdp_tpu.ops.norms import rms_norm
 from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
 
 
-def _decode_attention(q, k_cache, v_cache, cur_pos):
-    """q (B, 1, Nq, H) against cache (B, S, Nkv, H); positions > cur_pos
-    masked out. Returns (B, 1, Nq, H)."""
-    b, _, nq, h = q.shape
-    s, nkv = k_cache.shape[1], k_cache.shape[2]
-    group = nq // nkv
-    qg = q.reshape(b, nkv, group, h)  # squeeze the singleton seq dim
-    scores = jnp.einsum(
-        "bkgh,bskh->bkgs", qg, k_cache, preferred_element_type=jnp.float32
-    ) * (h**-0.5)
-    idx = jnp.arange(s)[None, None, None, :]
-    scores = jnp.where(idx <= cur_pos, scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bkgs,bskh->bkgh", probs, v_cache)
-    return out.reshape(b, 1, nq, h)
-
-
 def prefill(
     params,
     tokens,

@@ -21,7 +21,11 @@ import jax.numpy as jnp
 
 from fms_fsdp_tpu.models.configs import LlamaConfig
 from fms_fsdp_tpu.models.generation import decode_chunk, prefill
-from fms_fsdp_tpu.models.speculator import SpeculatorConfig, _layer_norm
+from fms_fsdp_tpu.models.speculator import (
+    SpeculatorConfig,
+    _layer_norm,
+    head_step,
+)
 
 
 def speculator_propose(spec_params, embed, last_tok, scfg: SpeculatorConfig):
@@ -31,30 +35,13 @@ def speculator_propose(spec_params, embed, last_tok, scfg: SpeculatorConfig):
     (at inference the teacher-forced inds of speculator_forward are the
     chain of the speculator's own picks)."""
     state = embed[:, None, :]  # (B, 1, D)
-    state_weight = 0.5 ** (0.5 / scfg.n_predict)
-    emb_weight = (1 - state_weight**2) ** 0.5
     if scfg.scale_input:
         state = _layer_norm(state) * (2**-0.5)
-
-    def pick(group, i):
-        if scfg.tie_weights:
-            if group == "proj":
-                return spec_params["proj"][min(i, len(spec_params["proj"]) - 1)]
-            return spec_params[group][0]
-        return spec_params[group][i]
 
     tok = last_tok[:, None]  # (B, 1)
     outs = []
     for i in range(scfg.n_predict):
-        z = pick("emb", i)[tok].astype(state.dtype)
-        state = (
-            state @ pick("proj", i).astype(state.dtype) * state_weight
-            + z * emb_weight
-        )
-        state = jax.nn.gelu(
-            _layer_norm(state, pick("ln_w", i), pick("ln_b", i))
-        )
-        logits = state @ pick("head", i).astype(state.dtype)
+        state, logits = head_step(spec_params, scfg, state, tok, i)
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, 1)
         outs.append(tok)
     return jnp.concatenate(outs, axis=1)  # (B, n_predict)

@@ -95,33 +95,47 @@ def init_speculator_params(key, scfg: SpeculatorConfig, dtype=jnp.float32) -> Pa
     }
 
 
+def _pick(params, scfg: SpeculatorConfig, group, i):
+    """Head-i parameter lookup honoring the tie_weights sharing rule."""
+    if scfg.tie_weights:
+        if group == "proj":
+            return params["proj"][min(i, len(params["proj"]) - 1)]
+        return params[group][0]
+    return params[group][i]
+
+
+def head_step(params, scfg: SpeculatorConfig, state, tok, i):
+    """One speculator head: fold token embedding into the state with the
+    variance-preserving weights, normalize+gelu, project to logits.
+    Shared by teacher-forced training (speculator_forward) and the
+    inference proposal chain (models/speculative.speculator_propose)."""
+    state_weight = 0.5 ** (0.5 / scfg.n_predict)
+    emb_weight = (1 - state_weight**2) ** 0.5
+    z = _pick(params, scfg, "emb", i)[tok].astype(state.dtype)
+    state = (
+        state @ _pick(params, scfg, "proj", i).astype(state.dtype) * state_weight
+        + z * emb_weight
+    )
+    state = jax.nn.gelu(
+        _layer_norm(
+            state, _pick(params, scfg, "ln_w", i), _pick(params, scfg, "ln_b", i)
+        )
+    )
+    logits = state @ _pick(params, scfg, "head", i).astype(state.dtype)
+    return state, logits
+
+
 def speculator_forward(params: Params, state, inds, scfg: SpeculatorConfig):
     """state (B, N, emb_dim): base-model embeddings; inds (B, >= N +
     n_predict - 1): known token indices, inds[:, i:i+N] feeding head i.
     Returns per-head logits (n_predict, B, N, V)."""
     n = state.shape[1]
-    state_weight = 0.5 ** (0.5 / scfg.n_predict)
-    emb_weight = (1 - state_weight**2) ** 0.5
-
     if scfg.scale_input:
         state = _layer_norm(state) * (2**-0.5)
 
-    def pick(group, i):
-        if scfg.tie_weights:
-            if group == "proj":
-                return params["proj"][min(i, len(params["proj"]) - 1)]
-            return params[group][0]
-        return params[group][i]
-
     out = []
     for i in range(scfg.n_predict):
-        tok = inds[:, i : i + n]
-        z = pick("emb", i)[tok].astype(state.dtype)
-        state = (
-            state @ pick("proj", i).astype(state.dtype) * state_weight
-            + z * emb_weight
-        )
-        state = jax.nn.gelu(_layer_norm(state, pick("ln_w", i), pick("ln_b", i)))
-        out.append(state @ pick("head", i).astype(state.dtype))
+        state, logits = head_step(params, scfg, state, inds[:, i : i + n], i)
+        out.append(logits)
 
     return jnp.stack(out, axis=0)

@@ -7,10 +7,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from fms_fsdp_tpu.models.configs import LlamaConfig
-from fms_fsdp_tpu.models.generation import generate, prefill
+from fms_fsdp_tpu.models.generation import decode_chunk, generate, prefill
 from fms_fsdp_tpu.models.llama import init_llama_params
 from fms_fsdp_tpu.models.speculative import (
-    decode_chunk,
     speculative_decode,
     speculator_propose,
 )
