@@ -23,8 +23,8 @@ from fms_fsdp_tpu.models.configs import LlamaConfig
 from fms_fsdp_tpu.models.generation import decode_chunk, prefill
 from fms_fsdp_tpu.models.speculator import (
     SpeculatorConfig,
-    _layer_norm,
     head_step,
+    scale_input,
 )
 
 
@@ -34,9 +34,7 @@ def speculator_propose(spec_params, embed, last_tok, scfg: SpeculatorConfig):
     int32 — each head's argmax feeds the next head's token input
     (at inference the teacher-forced inds of speculator_forward are the
     chain of the speculator's own picks)."""
-    state = embed[:, None, :]  # (B, 1, D)
-    if scfg.scale_input:
-        state = _layer_norm(state) * (2**-0.5)
+    state = scale_input(embed[:, None, :], scfg)  # (B, 1, D)
 
     tok = last_tok[:, None]  # (B, 1)
     outs = []

@@ -104,6 +104,14 @@ def _pick(params, scfg: SpeculatorConfig, group, i):
     return params[group][i]
 
 
+def scale_input(state, scfg: SpeculatorConfig):
+    """Optional input normalization applied once before the head chain —
+    shared by training and inference so the rule can't diverge."""
+    if scfg.scale_input:
+        return _layer_norm(state) * (2**-0.5)
+    return state
+
+
 def head_step(params, scfg: SpeculatorConfig, state, tok, i):
     """One speculator head: fold token embedding into the state with the
     variance-preserving weights, normalize+gelu, project to logits.
@@ -130,8 +138,7 @@ def speculator_forward(params: Params, state, inds, scfg: SpeculatorConfig):
     n_predict - 1): known token indices, inds[:, i:i+N] feeding head i.
     Returns per-head logits (n_predict, B, N, V)."""
     n = state.shape[1]
-    if scfg.scale_input:
-        state = _layer_norm(state) * (2**-0.5)
+    state = scale_input(state, scfg)
 
     out = []
     for i in range(scfg.n_predict):
