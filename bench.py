@@ -171,6 +171,18 @@ def main():
     # d_state 128 / MLP 14336), pure-Mamba layers, vocab cut to 32k so the
     # train state fits one chip — exercises the chunked SSD scan path
     add_row(
+        "mamba_9.8b-shaped (L=2, 32k vocab) bs=2 selAC=1/2 int8 seq=4096",
+        variant="mamba_9.8b",
+        batch_size=2,
+        sel_ac=0.5,
+        quant="int8_dgrad",
+        model_overrides={
+            "n_layer": 2,
+            "attn_layer_idx": (),
+            "vocab_size": 32000,
+        },
+    )
+    add_row(
         "mamba_9.8b-shaped (L=2, 32k vocab) bs=2 selAC=1/2 bf16 seq=4096",
         variant="mamba_9.8b",
         batch_size=2,

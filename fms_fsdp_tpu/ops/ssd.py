@@ -45,26 +45,35 @@ def _segsum(a):
     return jnp.where(mask, diff, -jnp.inf)
 
 
-def _intra_kernel(a_ref, dt_ref, x_ref, B_ref, C_ref, y_ref, s_ref, cb_ref, *, R):
+def _intra_kernel(cum_ref, dt_ref, x_ref, B_ref, C_ref, y_ref, s_ref, cb_ref, *, R):
     """Per-(batch, head) intra-chunk SSD: the (L, L) decay/score product
     lives only in VMEM — the HBM-bound part of the XLA formulation
     (several passes over a (B, L, L, G, R) fp32 tensor per chunk) becomes
     two MXU matmuls plus fused elementwise work.
+
+    Operands arrive head-major — x (B, H, L, P), B/C (B, G, L, N), and
+    cum/dt (B, H, 1, L) where cum is the chunk-local cumsum of the
+    per-token log-decay a (precomputed host-side: cumsum has no Pallas
+    TPU lowering) — so every block's trailing two dims equal the array
+    dims (the Mosaic lowering requires trailing block dims divisible by
+    (8, 128) or whole; the natural (B, L, H, P) layout puts a size-1 head
+    dim second-to-last and fails to lower).
 
     C@B^T is shared by every head in a GQA group; the grid walks heads
     fastest, so it is computed once per group into persistent VMEM
     scratch (``cb_ref``) and reused by the group's other R-1 heads (the
     B/C input blocks themselves are fetched once per group — their index
     map is constant across the group)."""
-    L = x_ref.shape[1]
+    L = x_ref.shape[2]
     h = pl.program_id(1)
-    a = a_ref[0]  # (1, L) fp32
-    dt = dt_ref[0]  # (1, L) fp32
-    x = x_ref[0, :, 0, :]  # (L, P) input dtype
-    B = B_ref[0, :, 0, :]  # (L, N)
-    C = C_ref[0, :, 0, :]  # (L, N)
+    # cum = cumsum of the per-token log-decay a, precomputed host-side
+    # (cumsum has no Pallas TPU lowering)
+    cum = cum_ref[0, 0]  # (1, L) fp32
+    dt = dt_ref[0, 0]  # (1, L) fp32
+    x = x_ref[0, 0]  # (L, P) input dtype
+    B = B_ref[0, 0]  # (L, N)
+    C = C_ref[0, 0]  # (L, N)
 
-    cum = jnp.cumsum(a, axis=-1)  # (1, L)
     cum_col = jnp.transpose(cum)  # (L, 1)
     seg = cum_col - cum  # (L, L): cum_i - cum_j
     mask = jax.lax.broadcasted_iota(jnp.int32, (L, L), 0) >= (
@@ -91,7 +100,7 @@ def _intra_kernel(a_ref, dt_ref, x_ref, B_ref, C_ref, y_ref, s_ref, cb_ref, *, R
         B, xs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # (N, P)
 
-    y_ref[0, :, 0, :] = y
+    y_ref[0, 0] = y
     s_ref[0, 0] = s
 
 
@@ -134,31 +143,34 @@ def _intra_and_states_pallas_fwd(xc, dtc, ac, Bc, Cc, G, interpret):
     Bsz, L, H, P = xc.shape
     N = Bc.shape[-1]
     R = H // G
-    a_rows = jnp.moveaxis(ac, 1, 2)  # (B, H, L)
-    dt_rows = jnp.moveaxis(dtc, 1, 2)
+    cum_rows = jnp.moveaxis(jnp.cumsum(ac, axis=1), 1, 2)[:, :, None, :]  # (B,H,1,L)
+    dt_rows = jnp.moveaxis(dtc, 1, 2)[:, :, None, :]
+    xh = jnp.moveaxis(xc, 1, 2)  # (B, H, L, P)
+    Bh = jnp.moveaxis(Bc, 1, 2)  # (B, G, L, N)
+    Ch = jnp.moveaxis(Cc, 1, 2)
 
     y, s = pl.pallas_call(
         functools.partial(_intra_kernel, R=R),
         grid=(Bsz, H),
         in_specs=[
-            pl.BlockSpec((1, 1, L), lambda b, h: (b, h, 0)),
-            pl.BlockSpec((1, 1, L), lambda b, h: (b, h, 0)),
-            pl.BlockSpec((1, L, 1, P), lambda b, h: (b, 0, h, 0)),
-            pl.BlockSpec((1, L, 1, N), lambda b, h, R=R: (b, 0, h // R, 0)),
-            pl.BlockSpec((1, L, 1, N), lambda b, h, R=R: (b, 0, h // R, 0)),
+            pl.BlockSpec((1, 1, 1, L), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, L), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, L, P), lambda b, h: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, L, N), lambda b, h, R=R: (b, h // R, 0, 0)),
+            pl.BlockSpec((1, 1, L, N), lambda b, h, R=R: (b, h // R, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, L, 1, P), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((1, 1, L, P), lambda b, h: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, N, P), lambda b, h: (b, h, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((Bsz, L, H, P), jnp.float32),
+            jax.ShapeDtypeStruct((Bsz, H, L, P), jnp.float32),
             jax.ShapeDtypeStruct((Bsz, H, N, P), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((L, L), jnp.float32)],
         interpret=interpret,
-    )(a_rows, dt_rows, xc, Bc, Cc)
-    return y, jnp.swapaxes(s, 2, 3)  # states (B, H, P, N)
+    )(cum_rows, dt_rows, xh, Bh, Ch)
+    return jnp.moveaxis(y, 1, 2), jnp.swapaxes(s, 2, 3)  # (B,L,H,P), (B,H,P,N)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
@@ -257,8 +269,14 @@ def ssd_scan(x, dt, A, Bm, Cm, D=None, chunk_size: int = 256, kernel: str = "aut
     Cc = jnp.moveaxis(Cm.reshape(Bsz, C, L, G, N), 1, 0)
 
     assert kernel in ("auto", "xla", "pallas"), f"unknown ssd kernel {kernel!r}"
-    # "auto" currently resolves to the XLA formulation; "pallas" runs the
-    # intra-chunk kernel (forward) with the XLA path as its backward
+    # "auto" resolves to the XLA formulation: measured on a real v5e at
+    # mamba-9.8b shapes (B=2, S=4096, H=128, P=64, G=1, N=128) the
+    # group-factored einsums run ~2x faster than the Pallas intra-chunk
+    # kernel, fwd and grad (BENCH_SSD.json for the numbers) — the
+    # per-(b,h) grid does tiny (256,256)@(256,64) matmuls and pays
+    # head-major relayouts per chunk, and XLA fuses the einsum path well.
+    # "pallas" stays available (exact parity on chip) as the base for a
+    # future chunk-fused kernel.
     mode = "xla" if kernel == "auto" else kernel
 
     @functools.partial(jax.checkpoint, prevent_cse=False)
@@ -318,14 +336,18 @@ def causal_conv1d(x, weight, bias=None, activation: str = "silu"):
 
     Expressed as W shifted fused multiply-adds instead of a grouped
     ``lax.conv``: XLA lowers a feature_group_count==C conv terribly on TPU
-    (~29ms fwd+bwd per mamba layer at 9.8b shapes vs ~1ms for the shifts,
-    which fuse with the bias/silu into a single elementwise pass)."""
+    (~29ms fwd+bwd per mamba layer at 9.8b shapes vs a few ms for the
+    shifts — BENCH_SSD.json for measured numbers). The pad stays in the
+    input dtype — materializing it in fp32 doubles the HBM traffic and
+    measured ~2x slower; the per-slice upcast fuses into the multiply-add
+    loop."""
     B, S, Cch = x.shape
     W = weight.shape[-1]
     wf = weight.astype(jnp.float32)
-    xt = jnp.pad(x.astype(jnp.float32), ((0, 0), (W - 1, 0), (0, 0)))
+    xt = jnp.pad(x, ((0, 0), (W - 1, 0), (0, 0)))
     out = sum(
-        lax.dynamic_slice_in_dim(xt, w, S, axis=1) * wf[None, None, :, w]
+        lax.dynamic_slice_in_dim(xt, w, S, axis=1).astype(jnp.float32)
+        * wf[None, None, :, w]
         for w in range(W)
     )
     if bias is not None:
