@@ -1,0 +1,171 @@
+"""Native perplexity evaluation over a trained checkpoint.
+
+The reference's evaluation path is: convert the sharded checkpoint to HF
+format and run EleutherAI lm-evaluation-harness externally
+(ref:docs/evaluation.md:1-5) — that path exists here too (fms_to_hf_llama
+/ fms_to_hf_mamba + the HF importers). This entry point additionally
+evaluates *natively* (no conversion, any mesh, any model family):
+token-mean negative log-likelihood and perplexity over a held-out stream
+from the same data pipeline used for training.
+
+Run:  python eval_ppl.py --ckpt_load_path=/path/to/run --model_variant=llama3_194m_4k \
+          --data_path=/data --eval_batches=50
+Dummy smoke:  python eval_ppl.py --use_dummy_dataset=True --eval_batches=8
+
+Prints one JSON line: {"nll": ..., "ppl": ..., "tokens": ...}.
+"""
+
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from fms_fsdp_tpu.config import TrainConfig
+from fms_fsdp_tpu.data.device_feed import DeviceFeed
+from fms_fsdp_tpu.data.loader import (
+    get_data_loader,
+    get_dummy_loader,
+    rebatch,
+)
+from fms_fsdp_tpu.models import get_model_api
+from fms_fsdp_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    data_parallel_extent,
+)
+from fms_fsdp_tpu.parallel.mixed_precision import get_dtype_policy
+from fms_fsdp_tpu.parallel.sharding import shard_params, tree_shardings
+from fms_fsdp_tpu.utils.checkpointing import load_params_only
+from fms_fsdp_tpu.utils.cli import parse_cli_args
+from fms_fsdp_tpu.utils.config_utils import get_model_config, update_config
+from fms_fsdp_tpu.ops.fused_ce import IGNORE_INDEX
+from fms_fsdp_tpu.utils.train_utils import setup, setup_environ_flags
+
+
+def make_eval_step(model_cfg, cfg, mesh):
+    """(params, (input, label)) -> (summed token NLL, token count).
+
+    Sums rather than means so perplexity can be aggregated exactly over
+    batches of unequal valid-token counts.
+    """
+    policy = get_dtype_policy(cfg)
+    _, forward_fn, _, _ = get_model_api(model_cfg)
+
+    from fms_fsdp_tpu.models import MixtralConfig
+
+    extra = (
+        # eval uses the exact dense-mix MoE path (no capacity drops)
+        {"moe_impl": "dense", "return_aux": True}
+        if isinstance(model_cfg, MixtralConfig)
+        else {}
+    )
+
+    @jax.jit
+    def eval_step(params, batch):
+        inputs, labels = batch
+        out = forward_fn(
+            params,
+            inputs,
+            model_cfg,
+            compute_dtype=policy.compute_dtype,
+            attn_impl=cfg.attention_kernel,
+            mesh=mesh,
+            **extra,
+        )
+        logits = out[0] if isinstance(out, tuple) else out
+        mask = labels != IGNORE_INDEX
+        safe = jnp.where(mask, labels, 0)
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        shifted = (logits - m).astype(jnp.float32)
+        logz = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) + m[..., 0].astype(
+            jnp.float32
+        )
+        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[
+            ..., 0
+        ].astype(jnp.float32)
+        nll = jnp.sum((logz - gold) * mask)
+        return nll, jnp.sum(mask)
+
+    return eval_step
+
+
+def main(**kwargs):
+    eval_batches = int(kwargs.pop("eval_batches", 50))
+    cfg = TrainConfig()
+    update_config(cfg, **kwargs)
+
+    setup()
+    setup_environ_flags()
+    rank = jax.process_index()
+    world_size = jax.process_count()
+
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    model_cfg = get_model_config(cfg.model_variant)
+    update_config(model_cfg, **kwargs)
+
+    data_extent = data_parallel_extent(mesh)
+    local_batch = cfg.batch_size * max(1, data_extent // world_size)
+    if not cfg.use_dummy_dataset:
+        loader = get_data_loader(cfg, rank, world_size)
+    else:
+        loader = get_dummy_loader(cfg, rank, world_size)
+
+    # Params only — no optimizer state is materialized or read (the Adam
+    # moments would triple eval memory; load_params_only skips them at the
+    # IO layer). A given --ckpt_load_path must resolve to a real
+    # checkpoint: unlike training, eval hard-fails rather than falling
+    # back to fresh weights.
+    init_params, _, specs_fn, _ = get_model_api(model_cfg)
+    policy = get_dtype_policy(cfg)
+    if cfg.ckpt_load_path:
+        path = (
+            os.path.join(cfg.ckpt_load_path, "checkpoints/")
+            if not os.path.isfile(cfg.ckpt_load_path)
+            and not os.path.isdir(os.path.join(cfg.ckpt_load_path, "state"))
+            else cfg.ckpt_load_path
+        )
+        params = load_params_only(
+            path, lambda k: init_params(k, model_cfg, dtype=policy.param_dtype)
+        )
+        params = shard_params(params, specs_fn(), mesh)
+    else:
+        # fresh-init smoke mode (sanity-checking the pipeline only)
+        def init_fn(k):
+            return init_params(k, model_cfg, dtype=policy.param_dtype)
+
+        shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(cfg.seed))
+        shardings = tree_shardings(
+            mesh, specs_fn(), jax.tree.map(lambda s: s.shape, shapes)
+        )
+        params = jax.jit(init_fn, out_shardings=shardings)(
+            jax.random.PRNGKey(cfg.seed)
+        )
+
+    eval_step = make_eval_step(model_cfg, cfg, mesh)
+    feed = DeviceFeed(
+        rebatch(loader, local_batch, cfg.batch_size), mesh, prefetch=2
+    )
+    it = iter(feed)
+
+    total_nll, total_tokens = 0.0, 0
+    for _ in range(eval_batches):
+        nll, count = eval_step(params, next(it))
+        total_nll += float(nll)
+        total_tokens += int(count)
+
+    nll = total_nll / max(1, total_tokens)
+    result = {
+        "nll": round(nll, 6),
+        "ppl": round(float(jnp.exp(nll)), 4),
+        "tokens": total_tokens,
+        "model_variant": cfg.model_variant,
+    }
+    if rank == 0:
+        print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main(**parse_cli_args(sys.argv[1:]))

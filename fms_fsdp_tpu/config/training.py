@@ -48,6 +48,7 @@ class TrainConfig:
     sharding_group_size: Optional[int] = None  # fsdp-axis size for hsdp; None = one group per host/slice
     tensor_parallel_size: int = 1  # "tensor" mesh axis (megatron-style TP)
     context_parallel_size: int = 1  # "context" mesh axis (ring/blockwise attention)
+    expert_parallel_size: int = 1  # "expert" mesh axis (MoE expert parallelism)
     fsdp_activation_checkpointing: bool = False
     selective_checkpointing: Union[float, str] = 1  # fraction of blocks to remat
     mixed_precision: bool = True  # bf16 compute/reduce, fp32 params (bfSixteen analog)

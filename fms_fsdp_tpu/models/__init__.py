@@ -1,6 +1,12 @@
-from fms_fsdp_tpu.models.configs import LlamaConfig, MambaConfig
+from fms_fsdp_tpu.models.configs import LlamaConfig, MambaConfig, MixtralConfig
 
-__all__ = ["LlamaConfig", "MambaConfig", "get_model_api", "get_base_api"]
+__all__ = [
+    "LlamaConfig",
+    "MambaConfig",
+    "MixtralConfig",
+    "get_model_api",
+    "get_base_api",
+]
 
 
 def get_model_api(model_cfg):
@@ -9,6 +15,19 @@ def get_model_api(model_cfg):
     init_fn(key, cfg, dtype) -> params; forward_fn(params, tokens, cfg, ...)
     -> logits; specs_fn() -> PartitionSpec tree mirroring params.
     """
+    if isinstance(model_cfg, MixtralConfig):
+        from fms_fsdp_tpu.models.mixtral import (
+            init_mixtral_params,
+            mixtral_forward,
+            mixtral_param_specs,
+        )
+
+        return (
+            init_mixtral_params,
+            mixtral_forward,
+            mixtral_param_specs,
+            model_cfg.nlayers,
+        )
     if isinstance(model_cfg, MambaConfig):
         from fms_fsdp_tpu.models.mamba import (
             init_mamba_params,

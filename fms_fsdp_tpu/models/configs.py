@@ -9,6 +9,11 @@ max_expected_seq_len, rope_theta, vocab size.
 ``MambaConfig`` mirrors the mamba_9.8b dict config
 (ref:fms_fsdp/utils/config_utils.py:162-185): Mamba2 layers with a few
 interleaved attention layers, RMSNorm, residual in fp32.
+
+``MixtralConfig`` covers the sparse-MoE Llama family the reference touches
+only as a frozen speculator base (ref:speculator/train_speculator_utils.py:
+500-569); here it is additionally a first-class trainable family with
+capacity-based routing and expert parallelism (models/mixtral.py).
 """
 
 import dataclasses
@@ -138,4 +143,51 @@ class MambaConfig:
             + d  # final norm
             + 2 * self.padded_vocab_size * d
         )
+        return int(total)
+
+
+@dataclass(frozen=True)
+class MixtralConfig:
+    """Sparse-MoE Llama family (Mixtral). Frozen speculator base
+    (the reference's EmbedMixtral) and trainable MoE model."""
+
+    src_vocab_size: int = 32000
+    emb_dim: int = 4096
+    nheads: int = 32
+    kvheads: int = 8
+    nlayers: int = 32
+    hidden_dim: int = 14336
+    num_experts: int = 8
+    top_k: int = 2
+    max_expected_seq_len: int = 4096
+    rope_theta: float = 1e6
+    norm_eps: float = 1e-5
+    # training-only knobs (ignored by the dense frozen-base path):
+    # per-expert buffer size = capacity_factor * top_k * S / num_experts
+    capacity_factor: float = 2.0
+    # load-balancing auxiliary loss coefficient (HF router_aux_loss_coef)
+    aux_loss_weight: float = 0.02
+
+    @property
+    def head_dim(self) -> int:
+        return self.emb_dim // self.nheads
+
+    @property
+    def n_kv_heads(self) -> int:
+        return self.kvheads if self.kvheads else self.nheads
+
+    def n_params(self, include_embeddings: bool = True) -> int:
+        d, h, E = self.emb_dim, self.hidden_dim, self.num_experts
+        kv_dim = self.n_kv_heads * self.head_dim
+        per_layer = (
+            d * d  # wq
+            + 2 * d * kv_dim  # wk, wv
+            + d * d  # wo
+            + d * E  # router gate
+            + 3 * E * d * h  # per-expert w1, w3, w2
+            + 2 * d  # norms
+        )
+        total = self.nlayers * per_layer + d
+        if include_embeddings:
+            total += 2 * self.src_vocab_size * d
         return int(total)

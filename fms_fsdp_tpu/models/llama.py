@@ -33,6 +33,7 @@ from fms_fsdp_tpu.ops.norms import rms_norm
 from fms_fsdp_tpu.ops.quant import matmul as qmatmul
 from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
 from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, AXIS_TENSOR, DATA_AXES
+from fms_fsdp_tpu.parallel.sharding import constrain
 
 Params = Dict[str, Any]
 
@@ -96,16 +97,13 @@ def param_count(params) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _constrain(x, spec: Optional[P], mesh: Optional[Mesh]):
-    if mesh is None:
-        return x
-    return lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
+_constrain = constrain  # shared helper (parallel/sharding.py)
 
 
-def _llama_block(
+def attention_block(
     x,
     layer: Params,
-    cfg: LlamaConfig,
+    cfg,
     cos,
     sin,
     *,
@@ -113,16 +111,19 @@ def _llama_block(
     mesh: Optional[Mesh],
     quant: str = "none",
 ):
-    """One decoder block: x + Attn(RMS(x)); then x + SwiGLU(RMS(x))."""
+    """x + Attn(RMS(x)) — the attention residual half, shared by every
+    Llama-family model (Llama, Mixtral). ``cfg`` needs head_dim / nheads /
+    n_kv_heads / norm_eps; ``layer`` needs attn_norm / wq / wk / wv / wo.
+
+    NOTE: params arrive pre-cast to the compute dtype (single cast site at
+    the forward entry — that placement is what makes GSPMD all-gather
+    bf16 bytes).
+    """
     b, s, d = x.shape
     hd = cfg.head_dim
     nq, nkv = cfg.nheads, cfg.n_kv_heads
 
     head_spec = P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR, None)
-
-    # NOTE: params arrive pre-cast to the compute dtype (single cast site at
-    # llama_forward entry — that placement is what makes GSPMD all-gather
-    # bf16 bytes).
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q = qmatmul(h, layer["wq"], quant=quant).reshape(b, s, nq, hd)
     k = qmatmul(h, layer["wk"], quant=quant).reshape(b, s, nkv, hd)
@@ -140,7 +141,25 @@ def _llama_block(
     else:
         o = attention(q, k, v, causal=True, impl=attn_impl)
     o = qmatmul(o.reshape(b, s, nq * hd), layer["wo"], quant=quant)
-    x = x + _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    return x + _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+
+
+def _llama_block(
+    x,
+    layer: Params,
+    cfg: LlamaConfig,
+    cos,
+    sin,
+    *,
+    attn_impl: str,
+    mesh: Optional[Mesh],
+    quant: str = "none",
+):
+    """One decoder block: x + Attn(RMS(x)); then x + SwiGLU(RMS(x))."""
+    b, s, d = x.shape
+    x = attention_block(
+        x, layer, cfg, cos, sin, attn_impl=attn_impl, mesh=mesh, quant=quant
+    )
 
     h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
     gate = jax.nn.silu(qmatmul(h, layer["w1"], quant=quant))

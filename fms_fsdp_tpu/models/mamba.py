@@ -124,10 +124,7 @@ def init_mamba_params(key, cfg: MambaConfig, dtype=jnp.float32) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def _constrain(x, spec, mesh):
-    if mesh is None:
-        return x
-    return lax.with_sharding_constraint(x, jax.sharding.NamedSharding(mesh, spec))
+from fms_fsdp_tpu.parallel.sharding import constrain as _constrain  # noqa: E402
 
 
 def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh, kernel="auto", quant="none"):

@@ -1,99 +1,247 @@
-"""Mixtral (sparse MoE Llama-family) — speculator base model.
+"""Mixtral (sparse MoE Llama-family) — trainable model + speculator base.
 
-The reference registers an ``EmbedMixtral`` base for speculator training
-(ref:speculator/train_speculator_utils.py:500-569). Frozen-base,
-forward-only implementation: Llama-style attention (GQA + RoPE +
-RMSNorm) with the FFN replaced by a top-2-of-E SwiGLU mixture.
+The reference touches Mixtral only as a frozen speculator base
+(``EmbedMixtral``, ref:speculator/train_speculator_utils.py:500-569,
+with the model math imported from fms). Here it is both that frozen base
+and a first-class trainable family: Llama-style attention (GQA + RoPE +
+RMSNorm) with the FFN replaced by a top-k-of-E SwiGLU mixture, trained
+with expert parallelism over the mesh's "expert" axis.
 
-Routing computes every expert densely and mixes with the (renormalized)
-top-2 softmax weights — for a frozen base this trades FLOPs (E/2 extra)
-for exact, jit-friendly static shapes; a capacity-based gather/scatter
-dispatch is the training-scale optimization, not needed for a frozen
-teacher.
+Two MoE implementations, selected by ``moe_impl``:
+
+- ``"dense"`` (default; the frozen-base path): every expert computes every
+  token, mixed by the renormalized top-k softmax weights. Exact and
+  jit-trivial; costs E/top_k extra FFN FLOPs — fine for a frozen teacher.
+- ``"dispatch"`` (the training path): GShard-style capacity-based routing
+  expressed as einsum one-hots — all shapes static, all compute MXU
+  matmuls. Each expert processes at most
+  ``capacity = capacity_factor * top_k * S / E`` tokens per batch row;
+  first choices fill buffers before second choices; overflow tokens drop
+  that expert's contribution (their residual stream passes through).
+  With the dispatched tensor sharded batch->"expert" axis, GSPMD inserts
+  the all-to-all pair of classic expert parallelism.
+
+The training path also returns the load-balancing auxiliary loss
+(Switch-style f.p product, pre-scaled by cfg.aux_loss_weight).
 """
 
-from dataclasses import dataclass
-from typing import Any, Dict
+import functools
+import math
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
 
-from fms_fsdp_tpu.ops.attention import attention
+from fms_fsdp_tpu.models.configs import MixtralConfig
+from fms_fsdp_tpu.models.llama import attention_block
 from fms_fsdp_tpu.ops.norms import rms_norm
-from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
+from fms_fsdp_tpu.ops.rope import rope_table
+from fms_fsdp_tpu.parallel.mesh import (
+    AXIS_CONTEXT,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_REPLICA,
+    AXIS_TENSOR,
+    DATA_AXES,
+)
+from fms_fsdp_tpu.parallel.sharding import constrain as _constrain
+
+__all__ = [
+    "MixtralConfig",
+    "init_mixtral_params",
+    "mixtral_forward",
+    "mixtral_param_specs",
+]
 
 Params = Dict[str, Any]
-
-
-@dataclass(frozen=True)
-class MixtralConfig:
-    src_vocab_size: int = 32000
-    emb_dim: int = 4096
-    nheads: int = 32
-    kvheads: int = 8
-    nlayers: int = 32
-    hidden_dim: int = 14336
-    num_experts: int = 8
-    top_k: int = 2
-    max_expected_seq_len: int = 4096
-    rope_theta: float = 1e6
-    norm_eps: float = 1e-5
-
-    @property
-    def head_dim(self) -> int:
-        return self.emb_dim // self.nheads
 
 
 def init_mixtral_params(key, cfg: MixtralConfig, dtype=jnp.float32) -> Params:
     d, hd, h, E = cfg.emb_dim, cfg.head_dim, cfg.hidden_dim, cfg.num_experts
     std = 0.02
-    keys = iter(jax.random.split(key, 8 * cfg.nlayers + 3))
+    out_std = std / (2 * cfg.nlayers) ** 0.5
+    keys = jax.random.split(key, 10)
 
-    def tn(k, shape):
+    def tn(k, shape, s=std):
         return (
-            jax.random.truncated_normal(k, -3, 3, shape, jnp.float32) * std
+            jax.random.truncated_normal(k, -3, 3, shape, jnp.float32) * s
         ).astype(dtype)
 
     L = cfg.nlayers
     layers = {
         "attn_norm": jnp.ones((L, d), dtype),
-        "wq": jnp.stack([tn(next(keys), (d, cfg.nheads * hd)) for _ in range(L)]),
-        "wk": jnp.stack([tn(next(keys), (d, cfg.kvheads * hd)) for _ in range(L)]),
-        "wv": jnp.stack([tn(next(keys), (d, cfg.kvheads * hd)) for _ in range(L)]),
-        "wo": jnp.stack([tn(next(keys), (cfg.nheads * hd, d)) for _ in range(L)]),
+        "wq": tn(keys[0], (L, d, cfg.nheads * hd)),
+        "wk": tn(keys[1], (L, d, cfg.kvheads * hd)),
+        "wv": tn(keys[2], (L, d, cfg.kvheads * hd)),
+        "wo": tn(keys[3], (L, cfg.nheads * hd, d), out_std),
         "ffn_norm": jnp.ones((L, d), dtype),
-        "gate": jnp.stack([tn(next(keys), (d, E)) for _ in range(L)]),
-        "w1": jnp.stack([tn(next(keys), (E, d, h)) for _ in range(L)]),
-        "w3": jnp.stack([tn(next(keys), (E, d, h)) for _ in range(L)]),
-        "w2": jnp.stack([tn(next(keys), (E, h, d)) for _ in range(L)]),
+        "gate": tn(keys[4], (L, d, E)),
+        "w1": tn(keys[5], (L, E, d, h)),
+        "w3": tn(keys[6], (L, E, d, h)),
+        "w2": tn(keys[7], (L, E, h, d), out_std),
     }
     return {
-        "embedding": tn(next(keys), (cfg.src_vocab_size, d)),
+        "embedding": tn(keys[8], (cfg.src_vocab_size, d)),
         "layers": layers,
         "norm": jnp.ones((d,), dtype),
-        "lm_head": tn(next(keys), (d, cfg.src_vocab_size)),
+        "lm_head": tn(keys[9], (d, cfg.src_vocab_size)),
     }
 
 
-def _moe_ffn(h, gate_w, w1, w3, w2, top_k):
-    """Dense-mix top-k MoE SwiGLU. h (B, S, D); w1/w3 (E, D, H); w2 (E, H, D)."""
-    router = (h @ gate_w).astype(jnp.float32)  # (B, S, E)
-    top_vals, top_idx = jax.lax.top_k(router, top_k)
-    weights = jax.nn.softmax(top_vals, axis=-1)  # renormalized over top-k
-    E = gate_w.shape[-1]
-    # scatter the top-k weights back to a dense (B, S, E) mixing matrix
-    mix = jnp.sum(
-        jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
-        * weights[..., None],
-        axis=-2,
+def mixtral_param_specs(scan: bool = True) -> Dict[str, Any]:
+    """PartitionSpec tree: attention follows the Llama megatron layout;
+    expert weights shard E over "expert" AND each expert's matrices over
+    fsdp/tensor — EP composes with ZeRO-3 and TP instead of replacing
+    them."""
+    l = (None,) if scan else ()
+    layers = {
+        "attn_norm": P(*l, None),
+        "wq": P(*l, AXIS_FSDP, AXIS_TENSOR),
+        "wk": P(*l, AXIS_FSDP, AXIS_TENSOR),
+        "wv": P(*l, AXIS_FSDP, AXIS_TENSOR),
+        "wo": P(*l, AXIS_TENSOR, AXIS_FSDP),
+        "ffn_norm": P(*l, None),
+        "gate": P(*l, AXIS_FSDP, None),
+        "w1": P(*l, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
+        "w3": P(*l, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
+        "w2": P(*l, AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP),
+    }
+    return {
+        "embedding": P(AXIS_TENSOR, AXIS_FSDP),
+        "layers": layers,
+        "norm": P(None),
+        "lm_head": P(AXIS_FSDP, AXIS_TENSOR),
+    }
+
+
+def moe_capacity(cfg: MixtralConfig, seq_len: int) -> int:
+    """Static per-expert buffer size per batch row."""
+    return max(
+        1,
+        int(
+            math.ceil(
+                cfg.capacity_factor * cfg.top_k * seq_len / cfg.num_experts
+            )
+        ),
     )
+
+
+def _router(h, gate_w, cfg: MixtralConfig):
+    """Shared routing math: renormalized top-k weights + aux loss.
+
+    Returns (top_idx (B,S,K) int, top_w (B,S,K) fp32, aux scalar fp32).
+    Router math is fp32 (softmax over logits from a bf16 matmul is
+    routing-decision-critical; the matmul itself is tiny: D x E).
+    """
+    logits = (h @ gate_w).astype(jnp.float32)  # (B, S, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, cfg.top_k)
+    top_w = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+
+    # load-balancing aux loss (Switch eq. 4 generalized to top-k):
+    # E * sum_e (fraction of choices routed to e) * (mean router prob of e);
+    # minimized at 1.0 by a uniform router.
+    E = cfg.num_experts
+    choice = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # (B, S, K, E)
+    f = jnp.mean(jnp.sum(choice, axis=2), axis=(0, 1)) / cfg.top_k
+    p = jnp.mean(probs, axis=(0, 1))
+    aux = cfg.aux_loss_weight * E * jnp.sum(f * p)
+    return top_idx, top_w, aux
+
+
+def _moe_ffn_dense(h, lp, cfg: MixtralConfig):
+    """Dense-mix top-k MoE SwiGLU (every expert computes every token).
+    h (B, S, D); w1/w3 (E, D, H); w2 (E, H, D)."""
+    top_idx, top_w, aux = _router(h, lp["gate"], cfg)
+    E = cfg.num_experts
+    mix = jnp.sum(
+        jax.nn.one_hot(top_idx, E, dtype=jnp.float32) * top_w[..., None],
+        axis=-2,
+    )  # (B, S, E)
     expert_out = jnp.einsum(
         "bseh,ehd->bsed",
-        jax.nn.silu(jnp.einsum("bsd,edh->bseh", h, w1))
-        * jnp.einsum("bsd,edh->bseh", h, w3),
-        w2,
+        jax.nn.silu(jnp.einsum("bsd,edh->bseh", h, lp["w1"]))
+        * jnp.einsum("bsd,edh->bseh", h, lp["w3"]),
+        lp["w2"],
     )  # (B, S, E, D)
-    return jnp.einsum("bse,bsed->bsd", mix.astype(h.dtype), expert_out)
+    return jnp.einsum("bse,bsed->bsd", mix.astype(h.dtype), expert_out), aux
+
+
+def _moe_ffn_dispatch(h, lp, cfg: MixtralConfig, mesh: Optional[Mesh]):
+    """Capacity-based einsum dispatch (GShard style).
+
+    Builds (B, S, E, C) one-hot dispatch/combine tensors with first
+    choices filling expert buffers before second choices, gathers tokens
+    into a (B, E, C, D) dispatched tensor sharded over the "expert" mesh
+    axis (the batch->expert reshard is the EP all-to-all), runs every
+    expert's SwiGLU as batched matmuls, and scatters back weighted by the
+    renormalized router weights.
+    """
+    B, S, D = h.shape
+    E, K = cfg.num_experts, cfg.top_k
+    C = moe_capacity(cfg, S)
+    top_idx, top_w, aux = _router(h, lp["gate"], cfg)
+
+    # Priority dispatch: choice round k claims buffer slots only after
+    # rounds < k. counts tracks per-expert slots already claimed.
+    counts = jnp.zeros((B, 1, E), jnp.float32)
+    dispatch = jnp.zeros((B, S, E, C), h.dtype)
+    combine = jnp.zeros((B, S, E, C), h.dtype)
+    for k in range(K):
+        mask_k = jax.nn.one_hot(top_idx[:, :, k], E, dtype=jnp.float32)
+        pos_k = jnp.cumsum(mask_k, axis=1) - mask_k + counts  # (B, S, E)
+        pos_in_e = jnp.sum(pos_k * mask_k, axis=-1)  # (B, S)
+        keep = pos_in_e < C
+        slot = jax.nn.one_hot(
+            pos_in_e.astype(jnp.int32), C, dtype=jnp.float32
+        )  # (B, S, C)
+        d_k = (
+            mask_k[..., None] * slot[:, :, None, :] * keep[:, :, None, None]
+        ).astype(h.dtype)
+        dispatch = dispatch + d_k
+        combine = combine + d_k * top_w[:, :, k, None, None].astype(h.dtype)
+        counts = counts + jnp.sum(mask_k, axis=1, keepdims=True)
+
+    # batch->expert reshard: B drops the expert axis, E picks it up
+    ep_spec = P((AXIS_REPLICA, AXIS_FSDP), AXIS_EXPERT, None, None)
+    xd = jnp.einsum("bsec,bsd->becd", dispatch, h)
+    xd = _constrain(xd, ep_spec, mesh)
+    hidden = jax.nn.silu(
+        jnp.einsum("becd,edh->bech", xd, lp["w1"])
+    ) * jnp.einsum("becd,edh->bech", xd, lp["w3"])
+    hidden = _constrain(
+        hidden, P((AXIS_REPLICA, AXIS_FSDP), AXIS_EXPERT, None, AXIS_TENSOR), mesh
+    )
+    out_e = jnp.einsum("bech,ehd->becd", hidden, lp["w2"])
+    out_e = _constrain(out_e, ep_spec, mesh)
+    y = jnp.einsum("bsec,becd->bsd", combine, out_e)
+    return _constrain(y, P(DATA_AXES, AXIS_CONTEXT, None), mesh), aux
+
+
+def _mixtral_block(
+    x,
+    layer: Params,
+    cfg: MixtralConfig,
+    cos,
+    sin,
+    *,
+    attn_impl: str,
+    mesh: Optional[Mesh],
+    quant: str,
+    moe_impl: str,
+):
+    x = attention_block(
+        x, layer, cfg, cos, sin, attn_impl=attn_impl, mesh=mesh, quant=quant
+    )
+
+    h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+    if moe_impl == "dispatch":
+        y, aux = _moe_ffn_dispatch(h, layer, cfg, mesh)
+    else:
+        y, aux = _moe_ffn_dense(h, layer, cfg)
+    return x + y, aux
 
 
 def mixtral_forward(
@@ -102,33 +250,70 @@ def mixtral_forward(
     cfg: MixtralConfig,
     *,
     compute_dtype=jnp.bfloat16,
+    attn_impl: str = "xla",
+    ac_mask: Optional[List[bool]] = None,
+    scan_layers: bool = True,
+    mesh: Optional[Mesh] = None,
+    moe_impl: str = "dense",
     return_embeds: bool = False,
+    return_hidden: bool = False,
+    return_aux: bool = False,
+    quant: str = "none",
     **_unused,
 ):
-    """tokens (B, S) -> logits (B, S, V); optionally the final hidden
-    states (the Embed* contract)."""
+    """tokens (B, S) -> logits (B, S, V) in the compute dtype.
+
+    ``return_aux`` additionally returns the summed (pre-weighted)
+    load-balancing loss — the training path. ``return_embeds`` returns
+    final hidden states (the frozen-base Embed* contract);
+    ``return_hidden`` returns only them (fused-loss path).
+    """
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     b, s = tokens.shape
-    hd = cfg.head_dim
+    nlayers = params["layers"]["wq"].shape[0]
     x = params["embedding"][tokens]
-    cos, sin = rope_table(s, hd, cfg.rope_theta)
+    x = _constrain(x, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    cos, sin = rope_table(s, cfg.head_dim, cfg.rope_theta)
 
-    L = params["layers"]["wq"].shape[0]
-    for i in range(L):
-        lp = jax.tree.map(lambda a: a[i], params["layers"])
-        h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(b, s, cfg.nheads, hd)
-        k = (h @ lp["wk"]).reshape(b, s, cfg.kvheads, hd)
-        v = (h @ lp["wv"]).reshape(b, s, cfg.kvheads, hd)
-        q = apply_rotary(q, cos, sin)
-        k = apply_rotary(k, cos, sin)
-        o = attention(q, k, v, causal=True, impl="xla")
-        x = x + o.reshape(b, s, -1) @ lp["wo"]
-        h = rms_norm(x, lp["ffn_norm"], cfg.norm_eps)
-        x = x + _moe_ffn(h, lp["gate"], lp["w1"], lp["w3"], lp["w2"], cfg.top_k)
+    block = functools.partial(
+        _mixtral_block,
+        cfg=cfg,
+        cos=cos,
+        sin=sin,
+        attn_impl=attn_impl,
+        mesh=mesh,
+        quant=quant,
+        moe_impl=moe_impl,
+    )
+    ac_mask = ac_mask if ac_mask is not None else [False] * nlayers
+    uniform = all(ac_mask) or not any(ac_mask)
+
+    if scan_layers and uniform:
+        body = block
+        if all(ac_mask):
+            body = jax.checkpoint(block, prevent_cse=False)
+
+        def scan_fn(carry, layer):
+            y, aux = body(carry, layer)
+            return y, aux
+
+        x, auxs = lax.scan(scan_fn, x, params["layers"])
+        aux_total = jnp.sum(auxs)
+    else:
+        remat_block = jax.checkpoint(block, prevent_cse=False)
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(nlayers):
+            layer = jax.tree.map(lambda a: a[i], params["layers"])
+            x, aux = (remat_block if ac_mask[i] else block)(x, layer)
+            aux_total = aux_total + aux
 
     embeds = rms_norm(x, params["norm"], cfg.norm_eps)
+    if return_hidden:
+        return (embeds, aux_total) if return_aux else embeds
     logits = embeds @ params["lm_head"]
+    logits = _constrain(logits, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
     if return_embeds:
         return logits, embeds
+    if return_aux:
+        return logits, aux_total
     return logits

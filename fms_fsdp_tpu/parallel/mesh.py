@@ -2,9 +2,9 @@
 
 The reference's sharding-strategy trichotomy (ddp / fsdp / hsdp mapping to
 NO_SHARD / FULL_SHARD / HYBRID_SHARD, ref:fms_fsdp/utils/train_utils.py:227-234)
-collapses into the *shape* of one 4-axis ``jax.sharding.Mesh``:
+collapses into the *shape* of one 5-axis ``jax.sharding.Mesh``:
 
-    ("replica", "fsdp", "context", "tensor")
+    ("replica", "fsdp", "expert", "context", "tensor")
 
 - ddp   -> fsdp axis size 1, replica = world: params replicated, gradients
            psum'ed over "replica" by GSPMD (NCCL all-reduce analog).
@@ -13,6 +13,11 @@ collapses into the *shape* of one 4-axis ``jax.sharding.Mesh``:
 - hsdp  -> replica = world // group, fsdp = group: shard within an ICI-local
            group, replicate across groups (DCN on multi-slice pods) —
            HYBRID_SHARD analog.
+- expert  -> expert-parallel axis (beyond-reference MoE training): MoE
+           expert weights shard their E dim here, while the axis doubles as
+           a data axis for dense layers (DATA_AXES) — the dispatch/combine
+           einsums reshard tokens batch->expert, which GSPMD lowers to the
+           all-to-all pair of classic EP.
 - tensor  -> megatron-style TP axis (speculator parity + headroom).
 - context -> sequence/ring-attention axis (beyond-reference long-context).
 
@@ -24,17 +29,21 @@ from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import jax
+import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
 AXIS_REPLICA = "replica"
 AXIS_FSDP = "fsdp"
+AXIS_EXPERT = "expert"
 AXIS_CONTEXT = "context"
 AXIS_TENSOR = "tensor"
-MESH_AXES = (AXIS_REPLICA, AXIS_FSDP, AXIS_CONTEXT, AXIS_TENSOR)
+MESH_AXES = (AXIS_REPLICA, AXIS_FSDP, AXIS_EXPERT, AXIS_CONTEXT, AXIS_TENSOR)
 
-# Axes a batch is sharded over (all data-parallel dimensions).
-DATA_AXES = (AXIS_REPLICA, AXIS_FSDP)
+# Axes a batch is sharded over (all data-parallel dimensions). The expert
+# axis is data-parallel for every dense computation; only MoE dispatch
+# reshards from it (see module docstring).
+DATA_AXES = (AXIS_REPLICA, AXIS_FSDP, AXIS_EXPERT)
 
 
 @dataclass(frozen=True)
@@ -43,6 +52,7 @@ class MeshConfig:
     sharding_group_size: Optional[int] = None  # fsdp-axis size under hsdp
     tensor_parallel_size: int = 1
     context_parallel_size: int = 1
+    expert_parallel_size: int = 1
 
     @classmethod
     def from_train_config(cls, cfg):
@@ -51,6 +61,7 @@ class MeshConfig:
             sharding_group_size=getattr(cfg, "sharding_group_size", None),
             tensor_parallel_size=getattr(cfg, "tensor_parallel_size", 1),
             context_parallel_size=getattr(cfg, "context_parallel_size", 1),
+            expert_parallel_size=getattr(cfg, "expert_parallel_size", 1),
         )
 
 
@@ -78,11 +89,13 @@ def build_mesh(
 
     tp = mesh_config.tensor_parallel_size or 1
     cp = mesh_config.context_parallel_size or 1
-    if world % (tp * cp) != 0:
+    ep = mesh_config.expert_parallel_size or 1
+    if world % (tp * cp * ep) != 0:
         raise ValueError(
-            f"world size {world} not divisible by tensor*context = {tp * cp}"
+            f"world size {world} not divisible by "
+            f"tensor*context*expert = {tp * cp * ep}"
         )
-    n_dp = world // (tp * cp)
+    n_dp = world // (tp * cp * ep)
 
     strategy = mesh_config.sharding_strategy
     if strategy == "ddp":
@@ -102,6 +115,11 @@ def build_mesh(
     else:
         raise ValueError(f"unknown sharding strategy: {strategy}")
 
-    shape = (replica, fsdp, cp, tp)
+    shape = (replica, fsdp, ep, cp, tp)
     device_array = mesh_utils.create_device_mesh(shape, devices=devices)
     return Mesh(device_array, MESH_AXES)
+
+
+def data_parallel_extent(mesh: Mesh) -> int:
+    """Number of ways the global batch is split (product of DATA_AXES)."""
+    return int(np.prod([mesh.shape[a] for a in DATA_AXES]))

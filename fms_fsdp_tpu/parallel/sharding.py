@@ -80,6 +80,17 @@ def resolve_spec(spec: P, shape, mesh: Mesh) -> P:
     return P(*out)
 
 
+def constrain(x, spec: Optional[P], mesh: Optional[Mesh]):
+    """``with_sharding_constraint`` with divisibility-resolved spec;
+    no-op without a mesh. The one constraint helper shared by every model
+    family (llama/mamba/mixtral)."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, resolve_spec(spec, x.shape, mesh))
+    )
+
+
 def named_sharding(mesh: Mesh, spec: P, shape=None) -> NamedSharding:
     if shape is not None:
         spec = resolve_spec(spec, shape, mesh)

@@ -136,7 +136,7 @@ def init_train_state(
     optimizer,
 ):
     """Create the fully sharded train state {params, opt_state, step} for
-    any supported model family (Llama, Mamba hybrid).
+    any supported model family (Llama, Mamba hybrid, Mixtral MoE).
 
     Init runs *inside jit with sharded outputs*: each device materializes
     only its own param/opt shards — the TPU analog of the reference's
@@ -193,13 +193,17 @@ def make_train_step(
     fused = cfg.fused_loss
     chunk = cfg.loss_chunk_size
 
-    from fms_fsdp_tpu.models import MambaConfig
+    from fms_fsdp_tpu.models import MambaConfig, MixtralConfig
 
-    extra_kwargs = (
-        {"mamba_kernel": cfg.mamba_kernel}
-        if isinstance(model_cfg, MambaConfig)
-        else {}
-    )
+    extra_kwargs = {}
+    moe = isinstance(model_cfg, MixtralConfig)
+    if isinstance(model_cfg, MambaConfig):
+        extra_kwargs = {"mamba_kernel": cfg.mamba_kernel}
+    elif moe:
+        # train with capacity-based routing + EP; the dense-mix path is the
+        # frozen-base/eval formulation. The forward returns the
+        # already-weighted load-balancing aux loss alongside the output.
+        extra_kwargs = {"moe_impl": "dispatch", "return_aux": True}
 
     def loss_fn(params, inputs, labels):
         out = forward_fn(
@@ -215,12 +219,15 @@ def make_train_step(
             quant=cfg.quantized_matmuls,
             **extra_kwargs,
         )
+        aux = 0.0
+        if moe:
+            out, aux = out
         if fused:
             from fms_fsdp_tpu.ops.fused_ce import fused_linear_cross_entropy
 
             w = params["lm_head"].astype(policy.compute_dtype)
-            return fused_linear_cross_entropy(out, w, labels, chunk)
-        return cross_entropy_loss(out, labels)
+            return fused_linear_cross_entropy(out, w, labels, chunk) + aux
+        return cross_entropy_loss(out, labels) + aux
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, batch):

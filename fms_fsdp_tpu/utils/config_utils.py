@@ -13,7 +13,12 @@ architectural hyperparameters, expressed as our native config dataclasses.
 import dataclasses
 
 from fms_fsdp_tpu.config import TrainConfig
-from fms_fsdp_tpu.models.configs import LlamaConfig, MambaAttnConfig, MambaConfig
+from fms_fsdp_tpu.models.configs import (
+    LlamaConfig,
+    MambaAttnConfig,
+    MambaConfig,
+    MixtralConfig,
+)
 
 
 def _set(config, name, value):
@@ -164,5 +169,22 @@ def get_model_config(model_variant):
             fused_add_norm=True,
             pad_vocab_size_multiple=16,
             tie_embeddings=False,
+        )
+    if model_variant == "mixtral_8x7b":
+        # Mixtral-8x7B (46.7B total / 12.9B active params): beyond-reference
+        # trainable MoE family; the reference uses this architecture only as
+        # a frozen speculator base via fms
+        # (ref:speculator/train_speculator_utils.py:500-569).
+        return MixtralConfig(
+            src_vocab_size=32000,
+            emb_dim=4096,
+            nheads=32,
+            kvheads=8,
+            nlayers=32,
+            hidden_dim=14336,
+            num_experts=8,
+            top_k=2,
+            max_expected_seq_len=4096,
+            rope_theta=1e6,
         )
     raise ValueError(f"model variant {model_variant} not supported.")

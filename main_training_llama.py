@@ -19,7 +19,11 @@ from fms_fsdp_tpu.config import TrainConfig
 from fms_fsdp_tpu.data import get_data_loader, get_dummy_loader
 from fms_fsdp_tpu.data.device_feed import DeviceFeed
 from fms_fsdp_tpu.data.loader import rebatch
-from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+from fms_fsdp_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    data_parallel_extent,
+)
 from fms_fsdp_tpu.train.step import (
     init_train_state,
     make_optimizer,
@@ -50,7 +54,7 @@ def main(**kwargs):
 
     # mesh (replaces FSDP wrapping/sharding policies)
     mesh = build_mesh(MeshConfig.from_train_config(cfg))
-    data_extent = mesh.shape["replica"] * mesh.shape["fsdp"]
+    data_extent = data_parallel_extent(mesh)
     if rank == 0:
         print(f"Sharding strategy = {cfg.sharding_strategy}, mesh = {dict(mesh.shape)}")
 
@@ -67,7 +71,7 @@ def main(**kwargs):
         print("Constructing datasets...")
     if data_extent < world_size or data_extent % world_size != 0:
         raise ValueError(
-            f"data-parallel extent {data_extent} (replica x fsdp) must be a "
+            f"data-parallel extent {data_extent} (replica x fsdp x expert) must be a "
             f"positive multiple of process count {world_size}; lower "
             "tensor/context parallel sizes or add devices"
         )

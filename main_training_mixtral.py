@@ -1,0 +1,29 @@
+"""Mixtral sparse-MoE pretraining entry point (beyond reference).
+
+The reference uses this architecture only as a frozen speculator base
+(ref:speculator/train_speculator_utils.py:500-569); here it is trainable
+with capacity-based routing and expert parallelism over the mesh's
+"expert" axis (models/mixtral.py). Orchestration is shared with the
+Llama entry — ``get_model_config("mixtral_8x7b")`` returns a
+MixtralConfig and the train-step factory dispatches to the MoE forward
+with the load-balancing aux loss folded into the objective.
+
+Run:  python main_training_mixtral.py --use_dummy_dataset=True \
+          --expert_parallel_size=8 --num_steps=100
+"""
+
+import sys
+
+from fms_fsdp_tpu.utils.cli import parse_cli_args
+
+from main_training_llama import main as _shared_main
+
+
+def main(**kwargs):
+    kwargs.setdefault("model_variant", "mixtral_8x7b")
+    kwargs.setdefault("vocab_size", 32000)
+    return _shared_main(**kwargs)
+
+
+if __name__ == "__main__":
+    main(**parse_cli_args(sys.argv[1:]))

@@ -29,7 +29,11 @@ from fms_fsdp_tpu.models.speculator import (
     SpeculatorConfig,
     init_speculator_params,
 )
-from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+from fms_fsdp_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    data_parallel_extent,
+)
 from fms_fsdp_tpu.parallel.sharding import llama_param_specs, shard_params
 from fms_fsdp_tpu.train.speculator import (
     make_speculator_optimizer,
@@ -157,7 +161,7 @@ def main(**kwargs):
         train_loader = get_data_loader(cfg, rank, world_size, postprocess=[])
     else:
         train_loader = get_dummy_loader(cfg, rank, world_size)
-    data_extent = mesh.shape["replica"] * mesh.shape["fsdp"]
+    data_extent = data_parallel_extent(mesh)
     local_batch = cfg.batch_size * max(1, data_extent // world_size)
     feed = DeviceFeed(
         rebatch(train_loader, local_batch, cfg.batch_size), mesh, prefetch=2

@@ -51,4 +51,4 @@ def test_device_feed(prefetch):
         assert isinstance(x, jax.Array)
         assert x.shape == (8, 8)
         # batch dim sharded over the data axes
-        assert x.sharding.spec[0] == ("replica", "fsdp")
+        assert x.sharding.spec[0] == ("replica", "fsdp", "expert")

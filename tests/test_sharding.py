@@ -51,20 +51,30 @@ def _cfg(**kw):
     return TrainConfig(**base)
 
 
+def _shape(**kw):
+    base = {"replica": 1, "fsdp": 1, "expert": 1, "context": 1, "tensor": 1}
+    base.update(kw)
+    return base
+
+
 def test_mesh_shapes():
     assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
     m = build_mesh(MeshConfig(sharding_strategy="fsdp"))
-    assert dict(m.shape) == {"replica": 1, "fsdp": 8, "context": 1, "tensor": 1}
+    assert dict(m.shape) == _shape(fsdp=8)
     m = build_mesh(MeshConfig(sharding_strategy="ddp"))
-    assert dict(m.shape) == {"replica": 8, "fsdp": 1, "context": 1, "tensor": 1}
+    assert dict(m.shape) == _shape(replica=8)
     m = build_mesh(MeshConfig(sharding_strategy="hsdp", sharding_group_size=4))
-    assert dict(m.shape) == {"replica": 2, "fsdp": 4, "context": 1, "tensor": 1}
+    assert dict(m.shape) == _shape(replica=2, fsdp=4)
     m = build_mesh(MeshConfig(sharding_strategy="fsdp", tensor_parallel_size=2))
-    assert dict(m.shape) == {"replica": 1, "fsdp": 4, "context": 1, "tensor": 2}
+    assert dict(m.shape) == _shape(fsdp=4, tensor=2)
     m = build_mesh(
         MeshConfig(sharding_strategy="fsdp", context_parallel_size=2)
     )
-    assert dict(m.shape) == {"replica": 1, "fsdp": 4, "context": 2, "tensor": 1}
+    assert dict(m.shape) == _shape(fsdp=4, context=2)
+    m = build_mesh(
+        MeshConfig(sharding_strategy="fsdp", expert_parallel_size=4)
+    )
+    assert dict(m.shape) == _shape(fsdp=2, expert=4)
     with pytest.raises(ValueError):
         build_mesh(MeshConfig(sharding_strategy="hsdp", sharding_group_size=3))
 
