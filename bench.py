@@ -193,6 +193,33 @@ def main():
             "vocab_size": 32000,
         },
     )
+    # mixtral_8x7b per-layer shapes (d 4096 / 32q 8kv heads / 14336-wide
+    # SwiGLU experts, top-2 routing) with experts cut 8->4 and one layer
+    # so fp32 state + Adam moments fit 16GB — exercises the scatter
+    # dispatch + capacity routing path. MFU counts activated FLOPs only.
+    add_row(
+        "mixtral_8x7b-shaped (L=1, E=4, cf=1.25) bs=2 AC int8 seq=4096",
+        variant="mixtral_8x7b",
+        batch_size=2,
+        sel_ac=1,
+        quant="int8_dgrad",
+        model_overrides={
+            "nlayers": 1,
+            "num_experts": 4,
+            "capacity_factor": 1.25,
+        },
+    )
+    add_row(
+        "mixtral_8x7b-shaped (L=1, E=4, cf=1.25) bs=2 AC bf16 seq=4096",
+        variant="mixtral_8x7b",
+        batch_size=2,
+        sel_ac=1,
+        model_overrides={
+            "nlayers": 1,
+            "num_experts": 4,
+            "capacity_factor": 1.25,
+        },
+    )
 
     head = rows[0]
     result = {

@@ -12,14 +12,19 @@ Two MoE implementations, selected by ``moe_impl``:
 - ``"dense"`` (default; the frozen-base path): every expert computes every
   token, mixed by the renormalized top-k softmax weights. Exact and
   jit-trivial; costs E/top_k extra FFN FLOPs — fine for a frozen teacher.
-- ``"dispatch"`` (the training path): GShard-style capacity-based routing
-  expressed as einsum one-hots — all shapes static, all compute MXU
-  matmuls. Each expert processes at most
+- ``"dispatch"`` (the training path): capacity-based routing moved by one
+  scatter-add and one gather. Each expert processes at most
   ``capacity = capacity_factor * top_k * S / E`` tokens per batch row;
   first choices fill buffers before second choices; overflow tokens drop
   that expert's contribution (their residual stream passes through).
   With the dispatched tensor sharded batch->"expert" axis, GSPMD inserts
   the all-to-all pair of classic expert parallelism.
+- ``"dispatch_einsum"``: the same routing semantics expressed as
+  GShard-style (B, S, E, C) one-hot einsums. Kept as the oracle the
+  scatter path is tested against — the dispatch+combine einsum pair costs
+  ``2 * B*S*E*C*D`` MACs with ``E*C = capacity_factor*top_k*S``
+  (quadratic in S; ~25-50% of the expert FFN FLOPs at Mixtral shapes),
+  where the scatter path is O(B*S*top_k*D) data movement.
 
 The training path also returns the load-balancing auxiliary loss
 (Switch-style f.p product, pre-scaled by cfg.aux_loss_weight).
@@ -37,6 +42,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from fms_fsdp_tpu.models.configs import MixtralConfig
 from fms_fsdp_tpu.models.llama import attention_block
 from fms_fsdp_tpu.ops.norms import rms_norm
+from fms_fsdp_tpu.ops.quant import expert_matmul
 from fms_fsdp_tpu.ops.rope import rope_table
 from fms_fsdp_tpu.parallel.mesh import (
     AXIS_CONTEXT,
@@ -169,54 +175,112 @@ def _moe_ffn_dense(h, lp, cfg: MixtralConfig):
     return jnp.einsum("bse,bsed->bsd", mix.astype(h.dtype), expert_out), aux
 
 
-def _moe_ffn_dispatch(h, lp, cfg: MixtralConfig, mesh: Optional[Mesh]):
-    """Capacity-based einsum dispatch (GShard style).
+def _priority_slots(top_idx, E: int, C: int):
+    """Per-choice expert-buffer slots under priority routing.
+
+    Choice round k claims an expert's slots only after rounds < k have
+    claimed theirs; within a round, tokens claim in sequence order.
+    Returns ``(slot, keep)``, both (B, S, K): the buffer position within
+    the chosen expert and whether it fit under capacity C.
+    """
+    counts = jnp.zeros((top_idx.shape[0], 1, E), jnp.int32)
+    slots = []
+    for k in range(top_idx.shape[-1]):
+        mask_k = jax.nn.one_hot(top_idx[:, :, k], E, dtype=jnp.int32)
+        pos_k = jnp.cumsum(mask_k, axis=1) - mask_k + counts  # (B, S, E)
+        slots.append(
+            jnp.take_along_axis(pos_k, top_idx[:, :, k, None], axis=-1)[..., 0]
+        )
+        counts = counts + jnp.sum(mask_k, axis=1, keepdims=True)
+    slot = jnp.stack(slots, axis=-1)
+    return slot, slot < C
+
+
+def _expert_ffn(xd, lp, mesh, quant: str = "none"):
+    """Per-expert SwiGLU over a dispatched E-major (E, B, C, D) tensor,
+    sharded batch->"expert" axis (the reshard is the EP all-to-all pair).
+
+    E-major because E is the batch dim of the per-expert dot_generals and
+    dot_general batch dims lead the output — B-major activations would
+    pay a full relayout of every (E, B, C, H) product (int32-wide on the
+    int8 path), measured as a net slowdown at Mixtral bench shapes."""
+    ep_spec = P(AXIS_EXPERT, (AXIS_REPLICA, AXIS_FSDP), None, None)
+    xd = _constrain(xd, ep_spec, mesh)
+    hidden = jax.nn.silu(expert_matmul(xd, lp["w1"], quant=quant)) * expert_matmul(
+        xd, lp["w3"], quant=quant
+    )
+    hidden = _constrain(
+        hidden, P(AXIS_EXPERT, (AXIS_REPLICA, AXIS_FSDP), None, AXIS_TENSOR), mesh
+    )
+    out_e = expert_matmul(hidden, lp["w2"], quant=quant)
+    return _constrain(out_e, ep_spec, mesh)
+
+
+def _moe_ffn_dispatch(
+    h, lp, cfg: MixtralConfig, mesh: Optional[Mesh], quant: str = "none"
+):
+    """Capacity-based dispatch via scatter/gather — the training default.
+
+    Routing semantics are identical to ``_moe_ffn_dispatch_einsum``
+    (priority slot claiming, overflow drop), but token movement is one
+    scatter-add into the flat (E*B*C)-row expert buffer and one gather
+    back — O(B*S*K*D) HBM traffic, the same op class as an embedding
+    update — instead of one-hot einsums whose MAC count is quadratic in
+    S. Dropped choices target a trailing dump row that is sliced off
+    before expert compute and gathered back as zeros. The buffer is laid
+    out E-major (see ``_expert_ffn``).
+    """
+    B, S, D = h.shape
+    E, K = cfg.num_experts, cfg.top_k
+    C = moe_capacity(cfg, S)
+    top_idx, top_w, aux = _router(h, lp["gate"], cfg)
+    slot, keep = _priority_slots(top_idx, E, C)
+
+    # flat row in the E-major (E, B, C) buffer; dropped choices -> dump row
+    b_ix = jnp.arange(B, dtype=top_idx.dtype)[:, None, None]
+    dest = jnp.where(keep, (top_idx * B + b_ix) * C + slot, E * B * C)
+    dest = dest.reshape(B * S * K)
+    src = jnp.broadcast_to(h[:, :, None, :], (B, S, K, D)).reshape(B * S * K, D)
+    xd = jnp.zeros((E * B * C + 1, D), h.dtype).at[dest].add(src)
+    out_e = _expert_ffn(xd[: E * B * C].reshape(E, B, C, D), lp, mesh, quant)
+
+    out_flat = jnp.concatenate(
+        [out_e.reshape(E * B * C, D), jnp.zeros((1, D), h.dtype)], axis=0
+    )
+    gathered = jnp.take(out_flat, dest, axis=0).reshape(B, S, K, D)
+    y = jnp.einsum("bskd,bsk->bsd", gathered, top_w.astype(h.dtype))
+    return _constrain(y, P(DATA_AXES, AXIS_CONTEXT, None), mesh), aux
+
+
+def _moe_ffn_dispatch_einsum(h, lp, cfg: MixtralConfig, mesh: Optional[Mesh]):
+    """Capacity-based einsum dispatch (GShard style) — oracle path.
 
     Builds (B, S, E, C) one-hot dispatch/combine tensors with first
     choices filling expert buffers before second choices, gathers tokens
-    into a (B, E, C, D) dispatched tensor sharded over the "expert" mesh
-    axis (the batch->expert reshard is the EP all-to-all), runs every
-    expert's SwiGLU as batched matmuls, and scatters back weighted by the
+    into an E-major (E, B, C, D) dispatched tensor, runs every expert's
+    SwiGLU as batched matmuls, and scatters back weighted by the
     renormalized router weights.
     """
     B, S, D = h.shape
     E, K = cfg.num_experts, cfg.top_k
     C = moe_capacity(cfg, S)
     top_idx, top_w, aux = _router(h, lp["gate"], cfg)
+    slot, keep = _priority_slots(top_idx, E, C)
 
-    # Priority dispatch: choice round k claims buffer slots only after
-    # rounds < k. counts tracks per-expert slots already claimed.
-    counts = jnp.zeros((B, 1, E), jnp.float32)
     dispatch = jnp.zeros((B, S, E, C), h.dtype)
     combine = jnp.zeros((B, S, E, C), h.dtype)
     for k in range(K):
-        mask_k = jax.nn.one_hot(top_idx[:, :, k], E, dtype=jnp.float32)
-        pos_k = jnp.cumsum(mask_k, axis=1) - mask_k + counts  # (B, S, E)
-        pos_in_e = jnp.sum(pos_k * mask_k, axis=-1)  # (B, S)
-        keep = pos_in_e < C
-        slot = jax.nn.one_hot(
-            pos_in_e.astype(jnp.int32), C, dtype=jnp.float32
-        )  # (B, S, C)
         d_k = (
-            mask_k[..., None] * slot[:, :, None, :] * keep[:, :, None, None]
+            jax.nn.one_hot(top_idx[:, :, k], E, dtype=jnp.float32)[..., None]
+            * jax.nn.one_hot(slot[:, :, k], C, dtype=jnp.float32)[:, :, None, :]
+            * keep[:, :, k, None, None]
         ).astype(h.dtype)
         dispatch = dispatch + d_k
         combine = combine + d_k * top_w[:, :, k, None, None].astype(h.dtype)
-        counts = counts + jnp.sum(mask_k, axis=1, keepdims=True)
 
-    # batch->expert reshard: B drops the expert axis, E picks it up
-    ep_spec = P((AXIS_REPLICA, AXIS_FSDP), AXIS_EXPERT, None, None)
-    xd = jnp.einsum("bsec,bsd->becd", dispatch, h)
-    xd = _constrain(xd, ep_spec, mesh)
-    hidden = jax.nn.silu(
-        jnp.einsum("becd,edh->bech", xd, lp["w1"])
-    ) * jnp.einsum("becd,edh->bech", xd, lp["w3"])
-    hidden = _constrain(
-        hidden, P((AXIS_REPLICA, AXIS_FSDP), AXIS_EXPERT, None, AXIS_TENSOR), mesh
-    )
-    out_e = jnp.einsum("bech,ehd->becd", hidden, lp["w2"])
-    out_e = _constrain(out_e, ep_spec, mesh)
-    y = jnp.einsum("bsec,becd->bsd", combine, out_e)
+    xd = jnp.einsum("bsec,bsd->ebcd", dispatch, h)
+    out_e = _expert_ffn(xd, lp, mesh)
+    y = jnp.einsum("bsec,ebcd->bsd", combine, out_e)
     return _constrain(y, P(DATA_AXES, AXIS_CONTEXT, None), mesh), aux
 
 
@@ -238,7 +302,9 @@ def _mixtral_block(
 
     h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
     if moe_impl == "dispatch":
-        y, aux = _moe_ffn_dispatch(h, layer, cfg, mesh)
+        y, aux = _moe_ffn_dispatch(h, layer, cfg, mesh, quant)
+    elif moe_impl == "dispatch_einsum":
+        y, aux = _moe_ffn_dispatch_einsum(h, layer, cfg, mesh)
     else:
         y, aux = _moe_ffn_dense(h, layer, cfg)
     return x + y, aux

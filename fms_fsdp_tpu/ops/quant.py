@@ -110,3 +110,72 @@ def matmul(x, w, *, quant: str = "none"):
     if quant != "none":
         raise ValueError(f"unknown quantized_matmuls value: {quant!r}")
     return x @ w
+
+
+def int8_expert_matmul_raw(x, w):
+    """Batched per-expert GEMM x (E, B, C, K) @ w (E, K, F) -> (E, B, C, F)
+    on the int8 MXU path. The E-major activation layout matters: E is the
+    dot_general batch dim and batch dims lead the output, so E-major in
+    means the (E, B, C, F) int32 accumulation comes out already in layout
+    — a B-major layout would force a full transpose of it per GEMM."""
+    qx, sx = _absmax_quant(x, axis=-1)  # (E, B, C, 1)
+    qw, sw = _absmax_quant(w, axis=1)  # (E, 1, F)
+    acc = jax.lax.dot_general(
+        qx,
+        qw,
+        (((3,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32,
+    )  # (E, B, C, F)
+    return (acc.astype(jnp.float32) * sx * sw[:, None]).astype(x.dtype)
+
+
+def _expert_dgrad(g, w, quantized: bool):
+    """dx = g @ w^T per expert: g (E, B, C, F), w (E, K, F) -> (E, B, C, K)."""
+    dims = (((3,), (2,)), ((0,), (0,)))
+    if not quantized:
+        return jax.lax.dot_general(g, w, dims)
+    qg, sg = _absmax_quant(g, axis=-1)  # (E, B, C, 1)
+    qw, sw = _absmax_quant(w, axis=2)  # (E, K, 1)
+    acc = jax.lax.dot_general(qg, qw, dims, preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * sg * jnp.squeeze(sw, -1)[:, None, None, :]
+
+
+def _expert_wgrad(x, g):
+    # dW (E, K, F) contracts the token dims (B, C); bf16 for the same
+    # bias-accumulation reason as _wgrad.
+    return jax.lax.dot_general(x, g, (((1, 2), (1, 2)), ((0,), (0,))))
+
+
+def _make_int8_expert_matmul(dgrad_int8: bool):
+    @jax.custom_vjp
+    def f(x, w):
+        return int8_expert_matmul_raw(x, w)
+
+    def fwd(x, w):
+        return int8_expert_matmul_raw(x, w), (x, w)
+
+    def bwd(res, g):
+        x, w = res
+        dx = _expert_dgrad(g, w, dgrad_int8)
+        dw = _expert_wgrad(x, g)
+        return dx.astype(x.dtype), dw.astype(w.dtype)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+int8_expert_matmul = _make_int8_expert_matmul(dgrad_int8=False)
+int8_expert_matmul_dgrad = _make_int8_expert_matmul(dgrad_int8=True)
+
+
+def expert_matmul(x, w, *, quant: str = "none"):
+    """MoE batched-expert GEMM x (E, B, C, K) @ w (E, K, F), same quant
+    modes as ``matmul``. Activations are E-major (see
+    ``int8_expert_matmul_raw``)."""
+    if quant == "int8":
+        return int8_expert_matmul(x, w)
+    if quant == "int8_dgrad":
+        return int8_expert_matmul_dgrad(x, w)
+    if quant != "none":
+        raise ValueError(f"unknown quantized_matmuls value: {quant!r}")
+    return jnp.einsum("ebck,ekf->ebcf", x, w)

@@ -11,7 +11,7 @@ The reference publishes MFU/HFU per the PaLM appendix-B convention
 - HFU additionally counts recomputed forward FLOPs for remat'ed blocks.
 """
 
-from fms_fsdp_tpu.models.configs import LlamaConfig
+from fms_fsdp_tpu.models.configs import LlamaConfig, MixtralConfig
 
 
 def llama_matmul_params(cfg: LlamaConfig) -> int:
@@ -79,10 +79,41 @@ def mamba_train_flops_per_token(cfg, seq_len: int, ac_fraction: float = 0.0):
     return mamba_fwd_flops_per_token(cfg, seq_len) * (3 + ac_fraction)
 
 
+def mixtral_matmul_params_active(cfg) -> int:
+    """Matmul params a token actually touches: dense attention + router +
+    the ``top_k`` activated expert FFNs + lm_head. The standard MoE MFU
+    convention counts activated FLOPs only — capacity slack
+    (capacity_factor > top_k buffer fill) and dispatch movement are real
+    work that does NOT count toward the numerator."""
+    d, h = cfg.emb_dim, cfg.hidden_dim
+    attn_dim = cfg.nheads * cfg.head_dim
+    kv_dim = cfg.n_kv_heads * cfg.head_dim
+    per_layer = (
+        d * attn_dim  # wq
+        + 2 * d * kv_dim  # wk, wv
+        + attn_dim * d  # wo
+        + d * cfg.num_experts  # router gate
+        + cfg.top_k * 3 * d * h  # activated expert SwiGLU
+    )
+    return cfg.nlayers * per_layer + cfg.src_vocab_size * d  # + lm_head
+
+
+def mixtral_fwd_flops_per_token(cfg, seq_len: int) -> float:
+    mm = 2 * mixtral_matmul_params_active(cfg)
+    attn = cfg.nlayers * 2 * seq_len * cfg.nheads * cfg.head_dim
+    return mm + attn
+
+
+def mixtral_train_flops_per_token(cfg, seq_len: int, ac_fraction: float = 0.0):
+    return mixtral_fwd_flops_per_token(cfg, seq_len) * (3 + ac_fraction)
+
+
 def train_flops_per_token(model_cfg, seq_len: int, ac_fraction: float = 0.0):
     """Family dispatch for MFU/HFU accounting."""
     if isinstance(model_cfg, LlamaConfig):
         return llama_train_flops_per_token(model_cfg, seq_len, ac_fraction)
+    if isinstance(model_cfg, MixtralConfig):
+        return mixtral_train_flops_per_token(model_cfg, seq_len, ac_fraction)
     return mamba_train_flops_per_token(model_cfg, seq_len, ac_fraction)
 
 

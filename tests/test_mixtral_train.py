@@ -16,6 +16,7 @@ from fms_fsdp_tpu.models.configs import MixtralConfig
 from fms_fsdp_tpu.models.mixtral import (
     _moe_ffn_dense,
     _moe_ffn_dispatch,
+    _moe_ffn_dispatch_einsum,
     init_mixtral_params,
     mixtral_forward,
     moe_capacity,
@@ -95,6 +96,58 @@ def test_dispatch_drops_overflow_tokens():
     # every later token overflowed: expert contribution is exactly zero
     assert float(jnp.max(jnp.abs(yd[0, 1:]))) == 0.0
     assert float(jnp.max(jnp.abs(ye[0, 1:]))) > 0.0
+
+
+def _random_moe_layer(key, cfg, D):
+    k0, k1, k2, k3 = jax.random.split(key, 4)
+    return {
+        "gate": jax.random.normal(k0, (D, cfg.num_experts)) * 0.5,
+        "w1": jax.random.normal(k1, (cfg.num_experts, D, cfg.hidden_dim)) * 0.1,
+        "w3": jax.random.normal(k2, (cfg.num_experts, D, cfg.hidden_dim)) * 0.1,
+        "w2": jax.random.normal(k3, (cfg.num_experts, cfg.hidden_dim, D)) * 0.1,
+    }
+
+
+def test_scatter_dispatch_matches_einsum_with_drops():
+    """The scatter/gather dispatch must reproduce the einsum oracle
+    bit-for-bit semantics — same priority slot claiming, same overflow
+    drops — at a capacity tight enough that tokens genuinely drop, in
+    both the forward value and the gradients."""
+    cfg = _tiny_cfg(capacity_factor=0.5)  # C < S*K/E: drops guaranteed
+    B, S, D = 2, 16, cfg.emb_dim
+    assert moe_capacity(cfg, S) < S * cfg.top_k // cfg.num_experts
+    h = jax.random.normal(jax.random.PRNGKey(0), (B, S, D), jnp.float32)
+    lp = _random_moe_layer(jax.random.PRNGKey(1), cfg, D)
+
+    ys, auxs = _moe_ffn_dispatch(h, lp, cfg, mesh=None)
+    ye, auxe = _moe_ffn_dispatch_einsum(h, lp, cfg, mesh=None)
+    assert jnp.allclose(auxs, auxe)
+    assert float(jnp.max(jnp.abs(ys - ye))) < 1e-5
+
+    def loss(impl):
+        def f(h, lp):
+            y, aux = impl(h, lp, cfg, None)
+            return jnp.sum(y**2) + aux
+
+        return jax.grad(f, argnums=(0, 1))(h, lp)
+
+    gs, ge = loss(_moe_ffn_dispatch), loss(_moe_ffn_dispatch_einsum)
+    for a, b in zip(jax.tree.leaves(gs), jax.tree.leaves(ge)):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-4, (a.shape,)
+
+
+def test_mixtral_flops_accounting():
+    """MoE MFU numerator counts top_k activated experts, not all E."""
+    from fms_fsdp_tpu.utils.flops import train_flops_per_token
+
+    cfg = _tiny_cfg()  # E=4, K=2
+    ref = _tiny_cfg(num_experts=1, top_k=1)
+    d, h, L = cfg.emb_dim, cfg.hidden_dim, cfg.nlayers
+    delta = train_flops_per_token(cfg, 32) - train_flops_per_token(ref, 32)
+    # one extra activated expert's SwiGLU + the wider router gate,
+    # at 2 FLOPs/param forward and the 3x train multiplier
+    expected = 3 * 2 * L * (3 * d * h + d * (cfg.num_experts - 1))
+    assert delta == expected
 
 
 def test_aux_loss_at_uniform_routing():
