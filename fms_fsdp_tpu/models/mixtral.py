@@ -157,6 +157,18 @@ def _router(h, gate_w, cfg: MixtralConfig):
     return top_idx, top_w, aux
 
 
+def _moe_stats(aux, keep=None):
+    """Per-layer MoE stats: the load-balancing loss term plus the
+    fraction of routing choices dropped by capacity overflow (0 for the
+    dense path, which never drops)."""
+    drop = (
+        1.0 - jnp.mean(keep.astype(jnp.float32))
+        if keep is not None
+        else jnp.zeros((), jnp.float32)
+    )
+    return {"balance": aux, "drop_frac": drop}
+
+
 def _moe_ffn_dense(h, lp, cfg: MixtralConfig):
     """Dense-mix top-k MoE SwiGLU (every expert computes every token).
     h (B, S, D); w1/w3 (E, D, H); w2 (E, H, D)."""
@@ -172,7 +184,8 @@ def _moe_ffn_dense(h, lp, cfg: MixtralConfig):
         * jnp.einsum("bsd,edh->bseh", h, lp["w3"]),
         lp["w2"],
     )  # (B, S, E, D)
-    return jnp.einsum("bse,bsed->bsd", mix.astype(h.dtype), expert_out), aux
+    y = jnp.einsum("bse,bsed->bsd", mix.astype(h.dtype), expert_out)
+    return y, _moe_stats(aux)
 
 
 def _priority_slots(top_idx, E: int, C: int):
@@ -249,7 +262,8 @@ def _moe_ffn_dispatch(
     )
     gathered = jnp.take(out_flat, dest, axis=0).reshape(B, S, K, D)
     y = jnp.einsum("bskd,bsk->bsd", gathered, top_w.astype(h.dtype))
-    return _constrain(y, P(DATA_AXES, AXIS_CONTEXT, None), mesh), aux
+    y = _constrain(y, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    return y, _moe_stats(aux, keep)
 
 
 def _moe_ffn_dispatch_einsum(h, lp, cfg: MixtralConfig, mesh: Optional[Mesh]):
@@ -281,7 +295,8 @@ def _moe_ffn_dispatch_einsum(h, lp, cfg: MixtralConfig, mesh: Optional[Mesh]):
     xd = jnp.einsum("bsec,bsd->ebcd", dispatch, h)
     out_e = _expert_ffn(xd, lp, mesh)
     y = jnp.einsum("bsec,ebcd->bsd", combine, out_e)
-    return _constrain(y, P(DATA_AXES, AXIS_CONTEXT, None), mesh), aux
+    y = _constrain(y, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    return y, _moe_stats(aux, keep)
 
 
 def _mixtral_block(
@@ -329,10 +344,13 @@ def mixtral_forward(
 ):
     """tokens (B, S) -> logits (B, S, V) in the compute dtype.
 
-    ``return_aux`` additionally returns the summed (pre-weighted)
-    load-balancing loss — the training path. ``return_embeds`` returns
-    final hidden states (the frozen-base Embed* contract);
-    ``return_hidden`` returns only them (fused-loss path).
+    ``return_aux`` additionally returns a stats dict — ``"balance"``,
+    the summed (pre-weighted) load-balancing loss the train step adds to
+    the objective, and ``"drop_frac"``, the layer-mean fraction of
+    routing choices dropped by capacity overflow (reported as a metric).
+    ``return_embeds`` returns final hidden states (the frozen-base
+    Embed* contract); ``return_hidden`` returns only them (fused-loss
+    path).
     """
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     b, s = tokens.shape
@@ -360,18 +378,25 @@ def mixtral_forward(
             body = jax.checkpoint(block, prevent_cse=False)
 
         def scan_fn(carry, layer):
-            y, aux = body(carry, layer)
-            return y, aux
+            y, stats = body(carry, layer)
+            return y, stats
 
-        x, auxs = lax.scan(scan_fn, x, params["layers"])
-        aux_total = jnp.sum(auxs)
+        x, stats_stack = lax.scan(scan_fn, x, params["layers"])
+        aux_total = {
+            "balance": jnp.sum(stats_stack["balance"]),
+            "drop_frac": jnp.mean(stats_stack["drop_frac"]),
+        }
     else:
         remat_block = jax.checkpoint(block, prevent_cse=False)
-        aux_total = jnp.zeros((), jnp.float32)
+        per_layer = []
         for i in range(nlayers):
             layer = jax.tree.map(lambda a: a[i], params["layers"])
-            x, aux = (remat_block if ac_mask[i] else block)(x, layer)
-            aux_total = aux_total + aux
+            x, stats = (remat_block if ac_mask[i] else block)(x, layer)
+            per_layer.append(stats)
+        aux_total = {
+            "balance": sum(s["balance"] for s in per_layer),
+            "drop_frac": sum(s["drop_frac"] for s in per_layer) / nlayers,
+        }
 
     embeds = rms_norm(x, params["norm"], cfg.norm_eps)
     if return_hidden:

@@ -220,14 +220,17 @@ def make_train_step(
             **extra_kwargs,
         )
         aux = 0.0
+        stats = {}
         if moe:
-            out, aux = out
+            out, moe_stats = out
+            aux = moe_stats["balance"]
+            stats["moe_drop_frac"] = moe_stats["drop_frac"]
         if fused:
             from fms_fsdp_tpu.ops.fused_ce import fused_linear_cross_entropy
 
             w = params["lm_head"].astype(policy.compute_dtype)
-            return fused_linear_cross_entropy(out, w, labels, chunk) + aux
-        return cross_entropy_loss(out, labels) + aux
+            return fused_linear_cross_entropy(out, w, labels, chunk) + aux, stats
+        return cross_entropy_loss(out, labels) + aux, stats
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state, batch):
@@ -246,7 +249,9 @@ def make_train_step(
         params_c = jax.tree.map(
             lambda p: p.astype(policy.compute_dtype), state["params"]
         )
-        loss, grads = jax.value_and_grad(loss_fn)(params_c, inputs, labels)
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params_c, inputs, labels
+        )
         # Global-norm clip with the norm accumulated in fp32 regardless of
         # grad dtype — matches torch clip_grad_norm_ (ref:train_utils.py:96);
         # the pre-clip norm is the value the reference logs.
@@ -265,6 +270,7 @@ def make_train_step(
             "loss": loss,
             "gnorm": gnorm,
             "lr": lr,
+            **stats,
         }
         return (
             {"params": params, "opt_state": opt_state, "step": state["step"] + 1},

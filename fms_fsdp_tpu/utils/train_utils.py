@@ -227,6 +227,12 @@ def _train_loop(
             )
             g_norm = float(sum(m["gnorm"] for m in fetched) / max(1, len(fetched)))
             current_lr = float(fetched[-1]["lr"])
+            # any extra model-family metrics (e.g. MoE moe_drop_frac)
+            extra_metrics = {
+                k: float(sum(m[k] for m in fetched) / max(1, len(fetched)))
+                for k in fetched[-1]
+                if k not in ("loss", "gnorm", "lr")
+            }
             elapsed_time = time.time() - loop_start
             new_tokens_seen = (
                 (batch_idx - start_step)
@@ -261,6 +267,8 @@ def _train_loop(
                     "overall token per day:",
                     int(new_tokens_seen / elapsed_time * 3600 * 24),
                 )
+                for k, v in extra_metrics.items():
+                    print(f"{k}:", v)
                 if tracker_fn:
                     tracker_fn(
                         {
@@ -272,6 +280,7 @@ def _train_loop(
                             "overall throughput (token per gpu per sec)": overall_throughput,
                             "gpu reserved memory": reserved_mem,
                             "gpu allocated memory": allocated_mem,
+                            **extra_metrics,
                         },
                         step=batch_idx,
                     )
