@@ -17,8 +17,10 @@ Two MoE implementations, selected by ``moe_impl``:
   ``capacity = capacity_factor * top_k * S / E`` tokens per batch row;
   first choices fill buffers before second choices; overflow tokens drop
   that expert's contribution (their residual stream passes through).
-  With the dispatched tensor sharded batch->"expert" axis, GSPMD inserts
-  the all-to-all pair of classic expert parallelism.
+  When the mesh has an expert axis > 1, the batch->expert reshard is an
+  explicit ``lax.all_to_all`` in a shard_map manual over only that axis
+  (``_moe_ffn_dispatch_a2a``); single-axis meshes use the plain GSPMD
+  formulation.
 - ``"dispatch_einsum"``: the same routing semantics expressed as
   GShard-style (B, S, E, C) one-hot einsums. Kept as the oracle the
   scatter path is tested against — the dispatch+combine einsum pair costs
@@ -229,6 +231,36 @@ def _expert_ffn(xd, lp, mesh, quant: str = "none"):
     return _constrain(out_e, ep_spec, mesh)
 
 
+def _fill_expert_buffer(h, top_idx, slot, keep, C: int, E: int):
+    """Scatter local batch rows into the flat E-major expert buffer.
+
+    Returns (dest (B*S*K,) flat row indices — dropped choices point at
+    the dump row — and the (E, B, C, D) buffer with the dump row sliced
+    off). Shared by the single-program and all-to-all dispatch paths so
+    the index arithmetic cannot drift between them.
+    """
+    B, S, D = h.shape
+    K = top_idx.shape[-1]
+    b_ix = jnp.arange(B, dtype=top_idx.dtype)[:, None, None]
+    dest = jnp.where(keep, (top_idx * B + b_ix) * C + slot, E * B * C)
+    dest = dest.reshape(B * S * K)
+    src = jnp.broadcast_to(h[:, :, None, :], (B, S, K, D)).reshape(B * S * K, D)
+    buf = jnp.zeros((E * B * C + 1, D), h.dtype).at[dest].add(src)
+    return dest, buf[: E * B * C].reshape(E, B, C, D)
+
+
+def _combine_from_buffer(out_e, dest, top_w, S: int):
+    """Gather each choice's expert output back (dump row reads as the
+    appended zero row) and mix with the renormalized router weights."""
+    E, B, C, D = out_e.shape
+    K = top_w.shape[-1]
+    out_flat = jnp.concatenate(
+        [out_e.reshape(E * B * C, D), jnp.zeros((1, D), out_e.dtype)], axis=0
+    )
+    gathered = jnp.take(out_flat, dest, axis=0).reshape(B, S, K, D)
+    return jnp.einsum("bskd,bsk->bsd", gathered, top_w.astype(out_e.dtype))
+
+
 def _moe_ffn_dispatch(
     h, lp, cfg: MixtralConfig, mesh: Optional[Mesh], quant: str = "none"
 ):
@@ -244,24 +276,104 @@ def _moe_ffn_dispatch(
     out E-major (see ``_expert_ffn``).
     """
     B, S, D = h.shape
-    E, K = cfg.num_experts, cfg.top_k
+    E = cfg.num_experts
     C = moe_capacity(cfg, S)
     top_idx, top_w, aux = _router(h, lp["gate"], cfg)
     slot, keep = _priority_slots(top_idx, E, C)
 
-    # flat row in the E-major (E, B, C) buffer; dropped choices -> dump row
-    b_ix = jnp.arange(B, dtype=top_idx.dtype)[:, None, None]
-    dest = jnp.where(keep, (top_idx * B + b_ix) * C + slot, E * B * C)
-    dest = dest.reshape(B * S * K)
-    src = jnp.broadcast_to(h[:, :, None, :], (B, S, K, D)).reshape(B * S * K, D)
-    xd = jnp.zeros((E * B * C + 1, D), h.dtype).at[dest].add(src)
-    out_e = _expert_ffn(xd[: E * B * C].reshape(E, B, C, D), lp, mesh, quant)
+    dest, xd = _fill_expert_buffer(h, top_idx, slot, keep, C, E)
+    out_e = _expert_ffn(xd, lp, mesh, quant)
+    y = _combine_from_buffer(out_e, dest, top_w, S)
+    y = _constrain(y, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    return y, _moe_stats(aux, keep)
 
-    out_flat = jnp.concatenate(
-        [out_e.reshape(E * B * C, D), jnp.zeros((1, D), h.dtype)], axis=0
-    )
-    gathered = jnp.take(out_flat, dest, axis=0).reshape(B, S, K, D)
-    y = jnp.einsum("bskd,bsk->bsd", gathered, top_w.astype(h.dtype))
+
+def _use_expert_a2a(cfg: MixtralConfig, mesh: Optional[Mesh]) -> bool:
+    """The explicit all-to-all path applies when the mesh actually has an
+    expert axis to exchange over and it divides the expert count."""
+    if mesh is None or AXIS_EXPERT not in mesh.shape:
+        return False
+    ep = int(mesh.shape[AXIS_EXPERT])
+    if ep > 1 and cfg.num_experts % ep != 0:
+        import warnings
+
+        warnings.warn(
+            f"num_experts={cfg.num_experts} is not divisible by the expert"
+            f" axis extent {ep}: falling back to the GSPMD dispatch, whose"
+            " expert reshard replicates the token buffer across the expert"
+            " axis (~E/top_k x the minimal all-to-all traffic). Pick"
+            " expert_parallel_size dividing num_experts.",
+            stacklevel=3,
+        )
+    return ep > 1 and cfg.num_experts % ep == 0
+
+
+def _moe_ffn_dispatch_a2a(
+    h, lp, cfg: MixtralConfig, mesh: Mesh, quant: str = "none"
+):
+    """Scatter dispatch with an explicit expert-axis all-to-all (EP).
+
+    Identical routing semantics to ``_moe_ffn_dispatch``, but the
+    batch->expert reshard is written as ``lax.all_to_all`` inside a
+    shard_map that is manual over ONLY the "expert" mesh axis — the
+    fsdp/tensor sharding of the expert weights and the replica/fsdp
+    sharding of the local batch stay with GSPMD. Left to GSPMD, the flat
+    scatter/gather's expert reshard lowers to replicating the token
+    buffer across the expert axis ("involuntary full rematerialization"
+    SPMD warnings; ~E/top_k x the minimal traffic). The explicit a2a
+    pair moves each token's top_k rows exactly once — the classic
+    GShard/Switch EP exchange.
+
+    Each shard scatters its local batch rows into a full (E, B_loc, C, D)
+    buffer, the a2a splits the E dim across expert shards while
+    concatenating the sender batches, experts compute on (E/ep,
+    B_loc*ep, C, D), and the inverse a2a brings each token's rows home
+    for the weighted combine.
+
+    The router (and all stats) run OUTSIDE the manual region and the
+    routing tensors enter the body batch-sharded: the body must have no
+    expert-replicated differentiable inputs, because the shard_map
+    transpose would psum their cotangents over the expert axis inside
+    the manual region, and a bf16 all-reduce there crashes XLA:CPU's
+    AllReducePromotion pass ("Invalid binary instruction opcode copy").
+    """
+    E = cfg.num_experts
+    top_idx, top_w, aux = _router(h, lp["gate"], cfg)
+    C = moe_capacity(cfg, h.shape[1])
+    slot, keep = _priority_slots(top_idx, E, C)
+
+    def body(h, top_idx, slot, keep, top_w, w1, w3, w2):
+        S = h.shape[1]  # h here is this expert shard's batch rows
+        dest, buf = _fill_expert_buffer(h, top_idx, slot, keep, C, E)
+        xd = lax.all_to_all(
+            buf, AXIS_EXPERT, split_axis=0, concat_axis=1, tiled=True
+        )  # (E/ep, B*ep, C, D)
+        hidden = jax.nn.silu(expert_matmul(xd, w1, quant=quant)) * expert_matmul(
+            xd, w3, quant=quant
+        )
+        out = expert_matmul(hidden, w2, quant=quant)
+        out = lax.all_to_all(
+            out, AXIS_EXPERT, split_axis=1, concat_axis=0, tiled=True
+        )  # (E, B, C, D)
+        return _combine_from_buffer(out, dest, top_w, S)
+
+    y = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(AXIS_EXPERT),
+            P(AXIS_EXPERT),
+            P(AXIS_EXPERT),
+            P(AXIS_EXPERT),
+            P(AXIS_EXPERT),
+            P(AXIS_EXPERT),
+            P(AXIS_EXPERT),
+            P(AXIS_EXPERT),
+        ),
+        out_specs=P(AXIS_EXPERT),
+        axis_names=frozenset({AXIS_EXPERT}),
+        check_vma=False,
+    )(h, top_idx, slot, keep, top_w, lp["w1"], lp["w3"], lp["w2"])
     y = _constrain(y, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
     return y, _moe_stats(aux, keep)
 
@@ -317,7 +429,10 @@ def _mixtral_block(
 
     h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
     if moe_impl == "dispatch":
-        y, aux = _moe_ffn_dispatch(h, layer, cfg, mesh, quant)
+        if _use_expert_a2a(cfg, mesh):
+            y, aux = _moe_ffn_dispatch_a2a(h, layer, cfg, mesh, quant)
+        else:
+            y, aux = _moe_ffn_dispatch(h, layer, cfg, mesh, quant)
     elif moe_impl == "dispatch_einsum":
         y, aux = _moe_ffn_dispatch_einsum(h, layer, cfg, mesh)
     else:

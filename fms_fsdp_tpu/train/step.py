@@ -201,8 +201,10 @@ def make_train_step(
         extra_kwargs = {"mamba_kernel": cfg.mamba_kernel}
     elif moe:
         # train with capacity-based routing + EP; the dense-mix path is the
-        # frozen-base/eval formulation. The forward returns the
-        # already-weighted load-balancing aux loss alongside the output.
+        # frozen-base/eval formulation. The forward returns a stats dict
+        # {balance, drop_frac} alongside the output: balance (the
+        # already-weighted load-balancing loss) joins the objective,
+        # drop_frac is reported as a metric.
         extra_kwargs = {"moe_impl": "dispatch", "return_aux": True}
 
     def loss_fn(params, inputs, labels):
