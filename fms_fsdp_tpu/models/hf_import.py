@@ -172,6 +172,7 @@ def mixtral_config_from_hf(hf_cfg) -> MixtralConfig:
         max_expected_seq_len=hf_cfg.max_position_embeddings,
         rope_theta=hf_cfg.rope_theta,
         norm_eps=hf_cfg.rms_norm_eps,
+        aux_loss_weight=getattr(hf_cfg, "router_aux_loss_coef", 0.02),
     )
 
 

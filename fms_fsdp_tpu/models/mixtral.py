@@ -211,23 +211,38 @@ def _priority_slots(top_idx, E: int, C: int):
     return slot, slot < C
 
 
-def _expert_ffn(xd, lp, mesh, quant: str = "none"):
-    """Per-expert SwiGLU over a dispatched E-major (E, B, C, D) tensor,
-    sharded batch->"expert" axis (the reshard is the EP all-to-all pair).
+def _expert_swiglu(xd, w1, w3, w2, quant, constrain_hidden=lambda t: t):
+    """Per-expert SwiGLU chain over an E-major (E, B, C, D) tensor; the
+    (E, B, C, H) hidden passes through ``constrain_hidden`` so each
+    caller can apply its own layout (the manual-region caller must not
+    mention the expert axis). Shared so the matmul/quant chain cannot
+    drift between the GSPMD and all-to-all paths.
 
     E-major because E is the batch dim of the per-expert dot_generals and
     dot_general batch dims lead the output — B-major activations would
     pay a full relayout of every (E, B, C, H) product (int32-wide on the
     int8 path), measured as a net slowdown at Mixtral bench shapes."""
+    hidden = jax.nn.silu(expert_matmul(xd, w1, quant=quant)) * expert_matmul(
+        xd, w3, quant=quant
+    )
+    return expert_matmul(constrain_hidden(hidden), w2, quant=quant)
+
+
+def _expert_ffn(xd, lp, mesh, quant: str = "none"):
+    """Expert SwiGLU with full GSPMD sharding: E over "expert", batch
+    over replica/fsdp, hidden width over "tensor"."""
     ep_spec = P(AXIS_EXPERT, (AXIS_REPLICA, AXIS_FSDP), None, None)
     xd = _constrain(xd, ep_spec, mesh)
-    hidden = jax.nn.silu(expert_matmul(xd, lp["w1"], quant=quant)) * expert_matmul(
-        xd, lp["w3"], quant=quant
+    out_e = _expert_swiglu(
+        xd,
+        lp["w1"],
+        lp["w3"],
+        lp["w2"],
+        quant,
+        lambda t: _constrain(
+            t, P(AXIS_EXPERT, (AXIS_REPLICA, AXIS_FSDP), None, AXIS_TENSOR), mesh
+        ),
     )
-    hidden = _constrain(
-        hidden, P(AXIS_EXPERT, (AXIS_REPLICA, AXIS_FSDP), None, AXIS_TENSOR), mesh
-    )
-    out_e = expert_matmul(hidden, lp["w2"], quant=quant)
     return _constrain(out_e, ep_spec, mesh)
 
 
@@ -348,10 +363,15 @@ def _moe_ffn_dispatch_a2a(
         xd = lax.all_to_all(
             buf, AXIS_EXPERT, split_axis=0, concat_axis=1, tiled=True
         )  # (E/ep, B*ep, C, D)
-        hidden = jax.nn.silu(expert_matmul(xd, w1, quant=quant)) * expert_matmul(
-            xd, w3, quant=quant
+        out = _expert_swiglu(
+            xd,
+            w1,
+            w3,
+            w2,
+            quant,
+            # expert dim is manual here; only auto axes may appear
+            lambda t: _constrain(t, P(None, None, None, AXIS_TENSOR), mesh),
         )
-        out = expert_matmul(hidden, w2, quant=quant)
         out = lax.all_to_all(
             out, AXIS_EXPERT, split_axis=1, concat_axis=0, tiled=True
         )  # (E, B, C, D)

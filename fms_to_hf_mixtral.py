@@ -1,0 +1,125 @@
+"""Convert a framework Mixtral checkpoint to HuggingFace format.
+
+Companion to ``fms_to_hf_llama.py`` (the reference ships converters for
+its trainable families, ref:fms_to_hf_llama.py:11-167; Mixtral is
+trainable here, so it gets the same export path). Inverse of the import
+mapping in ``fms_fsdp_tpu/models/hf_import.py:162-219``:
+
+    embedding (V, D)          -> model.embed_tokens.weight
+    layers.wq[i] (D, N*hd)    -> model.layers.i.self_attn.q_proj.weight^T
+    layers.gate[i] (D, E)     -> model.layers.i.block_sparse_moe.gate.weight^T
+    layers.w1[i] (E, D, H)[e] -> ...block_sparse_moe.experts.e.w1.weight^T
+    layers.w2[i] (E, H, D)[e] -> ...block_sparse_moe.experts.e.w2.weight^T
+    lm_head (D, V)            -> lm_head.weight^T
+
+Usage:
+    python fms_to_hf_mixtral.py --model_variant=mixtral_8x7b \\
+        --load_path=/ckpts/checkpoints/step_1000_ckp \\
+        --save_path=/out/hf_model [--tokenizer_name_or_path=/tok]
+"""
+
+import sys
+
+import numpy as np
+
+from fms_fsdp_tpu.models.configs import MixtralConfig
+from fms_fsdp_tpu.utils.cli import parse_cli_args
+from fms_fsdp_tpu.utils.config_utils import get_model_config, update_config
+
+
+def params_to_hf_state_dict(params, cfg: MixtralConfig):
+    """Our param pytree -> HF MixtralForCausalLM state dict (numpy fp32)."""
+
+    def t(x):
+        return np.asarray(x, dtype=np.float32).T
+
+    sd = {
+        "model.embed_tokens.weight": np.asarray(
+            params["embedding"], dtype=np.float32
+        ),
+        "model.norm.weight": np.asarray(params["norm"], dtype=np.float32),
+        "lm_head.weight": t(params["lm_head"]),
+    }
+    L = np.asarray(params["layers"]["wq"]).shape[0]
+    for i in range(L):
+        lp = f"model.layers.{i}"
+        layer = {k: np.asarray(v[i]) for k, v in params["layers"].items()}
+        sd[f"{lp}.self_attn.q_proj.weight"] = t(layer["wq"])
+        sd[f"{lp}.self_attn.k_proj.weight"] = t(layer["wk"])
+        sd[f"{lp}.self_attn.v_proj.weight"] = t(layer["wv"])
+        sd[f"{lp}.self_attn.o_proj.weight"] = t(layer["wo"])
+        sd[f"{lp}.input_layernorm.weight"] = np.asarray(
+            layer["attn_norm"], dtype=np.float32
+        )
+        sd[f"{lp}.post_attention_layernorm.weight"] = np.asarray(
+            layer["ffn_norm"], dtype=np.float32
+        )
+        sd[f"{lp}.block_sparse_moe.gate.weight"] = t(layer["gate"])
+        for e in range(cfg.num_experts):
+            ep = f"{lp}.block_sparse_moe.experts.{e}"
+            sd[f"{ep}.w1.weight"] = t(layer["w1"][e])
+            sd[f"{ep}.w3.weight"] = t(layer["w3"][e])
+            sd[f"{ep}.w2.weight"] = t(layer["w2"][e])
+    return sd
+
+
+def hf_config(cfg: MixtralConfig):
+    from transformers import MixtralConfig as HFMixtralConfig
+
+    return HFMixtralConfig(
+        vocab_size=cfg.src_vocab_size,
+        hidden_size=cfg.emb_dim,
+        intermediate_size=cfg.hidden_dim,
+        num_hidden_layers=cfg.nlayers,
+        num_attention_heads=cfg.nheads,
+        num_key_value_heads=cfg.n_kv_heads,
+        num_local_experts=cfg.num_experts,
+        num_experts_per_tok=cfg.top_k,
+        max_position_embeddings=cfg.max_expected_seq_len,
+        rms_norm_eps=cfg.norm_eps,
+        rope_theta=cfg.rope_theta,
+        router_aux_loss_coef=cfg.aux_loss_weight,
+        tie_word_embeddings=False,
+    )
+
+
+def convert_to_hf(params, cfg: MixtralConfig):
+    """Build a transformers MixtralForCausalLM carrying our weights."""
+    import torch
+    from transformers import MixtralForCausalLM
+
+    model = MixtralForCausalLM(hf_config(cfg))
+    sd = {
+        k: torch.from_numpy(np.ascontiguousarray(v))
+        for k, v in params_to_hf_state_dict(params, cfg).items()
+    }
+    model.load_state_dict(sd, strict=True)
+    return model
+
+
+def load_params(load_path: str, cfg: MixtralConfig):
+    """Load params (only) from a checkpoint dir or single-file pickle."""
+    from fms_fsdp_tpu.models.mixtral import init_mixtral_params
+    from fms_fsdp_tpu.utils.checkpointing import load_params_only
+
+    return load_params_only(load_path, lambda k: init_mixtral_params(k, cfg))
+
+
+def main(**kwargs):
+    cfg = get_model_config(kwargs.get("model_variant", "mixtral_8x7b"))
+    update_config(cfg, **kwargs)
+    params = load_params(kwargs["load_path"], cfg)
+    model = convert_to_hf(params, cfg)
+    model.save_pretrained(kwargs["save_path"], safe_serialization=True)
+    print(f"HF model saved to {kwargs['save_path']}")
+
+    tok = kwargs.get("tokenizer_name_or_path")
+    if tok:
+        from transformers import AutoTokenizer
+
+        AutoTokenizer.from_pretrained(tok).save_pretrained(kwargs["save_path"])
+        print("Tokenizer copied.")
+
+
+if __name__ == "__main__":
+    main(**parse_cli_args(sys.argv[1:]))
