@@ -260,6 +260,21 @@ def test_expert_parallel_matches_ep1():
     assert spec[1] == "expert"
 
 
+def test_context_parallel_moe_matches_cp1():
+    """MoE + context parallelism: the routing cumsum and dispatch span
+    the context-sharded sequence dim. Adding EP on top of CP must not
+    move the loss (the MoE dispatch is exact under sharding); CP itself
+    shifts bf16 ring-attention accumulation slightly vs cp=1."""
+    model_cfg = _tiny_cfg()
+    base, _ = _one_step_loss(_train_cfg(), model_cfg)
+    cp, _ = _one_step_loss(_train_cfg(context_parallel_size=2), model_cfg)
+    cp_ep, _ = _one_step_loss(
+        _train_cfg(context_parallel_size=2, expert_parallel_size=2), model_cfg
+    )
+    assert abs(cp - cp_ep) < 1e-4, (cp, cp_ep)
+    assert abs(base - cp) < 2e-2, (base, cp)  # ring-attn bf16 tolerance
+
+
 def test_mixtral_memorization():
     """E2E: a tiny Mixtral memorizes a repeated batch (loss -> ~0)."""
     model_cfg = _tiny_cfg()
