@@ -13,6 +13,8 @@ placement is fully owned by the pipeline.
 import os
 from typing import Any, List, Set
 
+import numpy as np
+
 
 class ShardFileHandler:
     """Interface: open / length / get / slice over one shard file."""
@@ -32,8 +34,13 @@ class ShardFileHandler:
         Result must support len()."""
         raise NotImplementedError
 
-    def slice(self, doc, index: int, n_pull: int) -> List:
-        """Return doc[index : index + n_pull] as a python list."""
+    def slice(self, doc, index: int, n_pull: int) -> "np.ndarray":
+        """Return doc[index : index + n_pull] as a 1-D int numpy array.
+
+        Token chunks travel the whole host pipeline as numpy arrays —
+        per-token python-object conversion (arrow ``to_pylist``) was the
+        single hottest call of the loader at ~2/3 of iterator time.
+        """
         raise NotImplementedError
 
 
@@ -64,8 +71,8 @@ class ArrowHandler(ShardFileHandler):
             doc = doc.slice(0, len(doc) - 1)
         return doc
 
-    def slice(self, doc, index: int, n_pull: int) -> List:
-        return doc.slice(index, n_pull).to_pylist()
+    def slice(self, doc, index: int, n_pull: int) -> np.ndarray:
+        return doc.slice(index, n_pull).to_numpy(zero_copy_only=False)
 
 
 class ParquetHandler(ShardFileHandler):
@@ -101,8 +108,8 @@ class ParquetHandler(ShardFileHandler):
             doc = doc[:-1]
         return doc
 
-    def slice(self, doc: List, index: int, n_pull: int) -> List:
-        return doc[index : index + n_pull]
+    def slice(self, doc: List, index: int, n_pull: int) -> np.ndarray:
+        return np.asarray(doc[index : index + n_pull], dtype=np.int64)
 
 
 class AutoHandler(ShardFileHandler):

@@ -232,7 +232,8 @@ class StreamingDocDataset(StatefulDataset):
 
     def _emit_chunk(self, j, doc, n_chunks):
         """Chunk j of the doc, with bos on the first chunk and the delimiter
-        closing the last; accounts for the bos offset in slicing."""
+        closing the last; accounts for the bos offset in slicing. Chunks are
+        int64 numpy arrays end-to-end (see ShardFileHandler.slice)."""
         start_index = j * self.chunksize
         n_pull = self.chunksize
         if self.bos is not None:
@@ -242,11 +243,12 @@ class StreamingDocDataset(StatefulDataset):
                 start_index -= 1
         chunk = self.filehandler.slice(doc, start_index, n_pull)
         self.tokens_seen += len(chunk)
+        parts = [np.asarray(chunk, dtype=np.int64)]
         if self.bos is not None and j == 0:
-            chunk = [self.bos] + chunk
+            parts.insert(0, np.array([self.bos], dtype=np.int64))
         if j == n_chunks - 1:
-            chunk = chunk + [self.eos]
-        return chunk
+            parts.append(np.array([self.eos], dtype=np.int64))
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
     def __iter__(self):
         if not self.is_setup:
