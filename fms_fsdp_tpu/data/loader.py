@@ -8,10 +8,23 @@ logical sub-ranks — each worker is a full pipeline clone whose
 inside torch worker processes (worldsize *= num_workers,
 rank = rank * num_workers + worker_id, ref:dataset_utils.py:108-119), with
 batches drawn round-robin across workers (torch IterableDataset semantics).
-Async host prefetch happens at the device-feed layer (device_feed.py),
+
+With ``num_workers > 1`` each worker pipeline runs in its own thread
+feeding a bounded queue, and batches are popped round-robin — real host
+parallelism for the compute-bound tokenizing (ParquetHandler) path,
+since HF tokenizers' rust encode releases the GIL (the reference gets
+the same from torch DataLoader worker *processes*,
+ref:dataloader_utils.py:144-146). Round-robin popping preserves the
+exact single-threaded batch order, and loader checkpointing keeps the
+reference's worker semantics: CheckpointDataset auto-saves inside each
+worker at its own batch boundaries (which, as with torch's prefetching
+workers, may run slightly ahead of consumption).
+Async device prefetch happens at the device-feed layer (device_feed.py),
 which is where TPU step-time overlap actually comes from.
 """
 
+import queue
+import threading
 from copy import deepcopy
 from typing import Callable, List
 
@@ -64,9 +77,28 @@ class StatefulDataLoader:
     owns an inflated rank and saves its own ``loader_state_<rank>`` file.
     """
 
-    def __init__(self, dataset, batch_size: int = 1, num_workers: int = 1):
+    def __init__(
+        self,
+        dataset,
+        batch_size: int = 1,
+        num_workers: int = 1,
+        prefetch_batches: int = 2,
+    ):
         self.batch_size = batch_size
         self.num_workers = max(1, num_workers)
+        self.prefetch_batches = max(1, prefetch_batches)
+        self._threads: List[threading.Thread] = []
+        # per-iterator-generation stop event: set-and-abandoned on
+        # shutdown, REPLACED (never cleared) when a new iterator spawns
+        # workers — a straggler thread that outlives a 5s join timeout
+        # still sees ITS generation's event set and can never race a
+        # successor over the same pipeline object
+        self._stop = threading.Event()
+        # one lock per worker, held while that worker advances its
+        # pipeline: external state reads (state_dict/save_to_path — the
+        # speculator path checkpoints a live loader) grab all locks and
+        # observe every pipeline at a batch boundary
+        self._locks = [threading.Lock() for _ in range(self.num_workers)]
         if self.num_workers == 1:
             self.pipelines = [dataset]
         else:
@@ -84,34 +116,117 @@ class StatefulDataLoader:
     def dataset(self):
         return self.pipelines[0]
 
+    @staticmethod
+    def _worker_loop(pipeline, out_q, lock, stop, batch_size):
+        """Produce stacked batches from one worker pipeline into its queue.
+        Exceptions are forwarded so the consumer re-raises them. The lock
+        is held only while advancing the pipeline (never across the
+        blocking put — a full queue must not deadlock a state reader).
+
+        Static on purpose: a bound-method target would keep the loader
+        strongly referenced from the thread registry, so an abandoned
+        iterator's loader could never be garbage collected and __del__
+        could never signal its threads to exit."""
+        try:
+            it = iter(pipeline)
+            while not stop.is_set():
+                with lock:
+                    items = [next(it) for _ in range(batch_size)]
+                batch = _stack(items)
+                while not stop.is_set():
+                    try:
+                        out_q.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+            # bounded, stop-aware put: the consumer may already be gone
+            # (peer worker's error triggered shutdown, or the generator
+            # was abandoned) — never hang a dying worker on a full queue
+            while not stop.is_set():
+                try:
+                    out_q.put(e, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def shutdown(self):
+        """Stop worker threads (idempotent). Call before inspecting
+        pipeline state externally while an iterator is live."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
+
+    def __del__(self):
+        self._stop.set()  # reachable: worker threads don't reference self
+
     def __iter__(self):
         # Top-level setup propagates the (possibly worker-inflated)
         # rank/worldsize down the wrapper stack before any layer iterates.
         for p in self.pipelines:
             p.setup()
-        iterators = [iter(p) for p in self.pipelines]
+        if self.num_workers == 1:
+            it = iter(self.pipelines[0])
+            while True:
+                yield _stack([next(it) for _ in range(self.batch_size)])
+
+        self.shutdown()
+        self._stop = threading.Event()  # fresh generation (see __init__)
+        queues = [
+            queue.Queue(maxsize=self.prefetch_batches) for _ in self.pipelines
+        ]
+        self._threads = [
+            threading.Thread(
+                target=self._worker_loop,
+                args=(p, q, lk, self._stop, self.batch_size),
+                daemon=True,
+            )
+            for p, q, lk in zip(self.pipelines, queues, self._locks)
+        ]
+        for t in self._threads:
+            t.start()
         w = 0
         while True:
-            items = [next(iterators[w]) for _ in range(self.batch_size)]
-            yield _stack(items)
+            batch = queues[w].get()
+            if isinstance(batch, BaseException):
+                self.shutdown()
+                raise batch
+            yield batch
             w = (w + 1) % self.num_workers
 
     # -- state (delegates to every worker pipeline) -----------------------
 
+    class _AllLocks:
+        def __init__(self, locks):
+            self.locks = locks
+
+        def __enter__(self):
+            for lk in self.locks:
+                lk.acquire()
+
+        def __exit__(self, *exc):
+            for lk in reversed(self.locks):
+                lk.release()
+
     def state_dict(self) -> List[dict]:
-        return [p.state_dict() for p in self.pipelines]
+        with self._AllLocks(self._locks):
+            return [p.state_dict() for p in self.pipelines]
 
     def load_state_dict(self, state_dicts, sharded_input=False):
-        for p in self.pipelines:
-            p.load_state_dict(state_dicts, sharded_input)
+        with self._AllLocks(self._locks):
+            for p in self.pipelines:
+                p.load_state_dict(state_dicts, sharded_input)
 
     def save_to_path(self, path: str):
-        for p in self.pipelines:
-            p.save_to_path(path)
+        with self._AllLocks(self._locks):
+            for p in self.pipelines:
+                p.save_to_path(path)
 
     def load_from_path(self, path: str):
-        for p in self.pipelines:
-            p.load_from_path(path)
+        with self._AllLocks(self._locks):
+            for p in self.pipelines:
+                p.load_from_path(path)
 
 
 class SteadyCounter:

@@ -55,11 +55,12 @@ class BaseModelAPI:
     yields final hidden states, and a sampling generate that can return
     per-position embeds."""
 
-    def __init__(self, arch, init_fn, forward_embeds, generate_fn):
+    def __init__(self, arch, init_fn, forward_embeds, generate_fn, specs_fn):
         self.arch = arch
         self.init = init_fn
         self.forward_embeds = forward_embeds  # (params, tokens, cfg) -> (logits, embeds)
         self.generate = generate_fn  # (params, prompts, cfg, key=..., ...) -> toks[, embeds]
+        self.param_specs = specs_fn  # () -> PartitionSpec tree for shard_params
 
 
 def get_base_api(arch: str) -> "BaseModelAPI":
@@ -69,15 +70,19 @@ def get_base_api(arch: str) -> "BaseModelAPI":
     if key == "llama":
         from fms_fsdp_tpu.models.generation import generate
         from fms_fsdp_tpu.models.llama import init_llama_params, llama_forward
+        from fms_fsdp_tpu.parallel.sharding import llama_param_specs
 
         def fwd(params, tokens, cfg, **kw):
             return llama_forward(params, tokens, cfg, return_embeds=True, **kw)
 
-        return BaseModelAPI("llama", init_llama_params, fwd, generate)
+        return BaseModelAPI(
+            "llama", init_llama_params, fwd, generate, llama_param_specs
+        )
     if key in ("gptbigcode", "gpt_bigcode"):
         from fms_fsdp_tpu.models.gpt_bigcode import (
             generate_simple,
             gpt_bigcode_forward,
+            gpt_bigcode_param_specs,
             init_gpt_bigcode_params,
         )
 
@@ -91,12 +96,19 @@ def get_base_api(arch: str) -> "BaseModelAPI":
                 params, prompts, cfg, gpt_bigcode_forward, **kw
             )
 
-        return BaseModelAPI("gpt_bigcode", init_gpt_bigcode_params, fwd, gen)
+        return BaseModelAPI(
+            "gpt_bigcode",
+            init_gpt_bigcode_params,
+            fwd,
+            gen,
+            gpt_bigcode_param_specs,
+        )
     if key == "mixtral":
         from fms_fsdp_tpu.models.gpt_bigcode import generate_simple
         from fms_fsdp_tpu.models.mixtral import (
             init_mixtral_params,
             mixtral_forward,
+            mixtral_param_specs,
         )
 
         def fwd(params, tokens, cfg, **kw):
@@ -105,5 +117,7 @@ def get_base_api(arch: str) -> "BaseModelAPI":
         def gen(params, prompts, cfg, **kw):
             return generate_simple(params, prompts, cfg, mixtral_forward, **kw)
 
-        return BaseModelAPI("mixtral", init_mixtral_params, fwd, gen)
+        return BaseModelAPI(
+            "mixtral", init_mixtral_params, fwd, gen, mixtral_param_specs
+        )
     raise ValueError(f"unknown speculator base arch: {arch!r}")

@@ -74,6 +74,41 @@ def init_gpt_bigcode_params(key, cfg: GPTBigCodeConfig, dtype=jnp.float32) -> Pa
     }
 
 
+def gpt_bigcode_param_specs() -> Params:
+    """PartitionSpec tree for the GPTBigCode param tree (megatron layout,
+    same conventions as the Llama rulebook in parallel/sharding.py). The
+    reference shards every speculator base via fms TP/FSDP
+    (ref:speculator/train_speculator.py:133-160); without this rulebook
+    ``shard_params`` would silently replicate a 20B+ StarCoder base.
+
+    Layer weights carry a leading stacked-L axis (never sharded). The
+    fused MQA c_attn output dim (d + 2*head_dim) is usually not divisible
+    by the tensor extent, in which case resolve_spec drops that entry —
+    fsdp row sharding still applies.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from fms_fsdp_tpu.parallel.mesh import AXIS_FSDP, AXIS_TENSOR
+
+    layers = {
+        "ln1_w": P(None, None),
+        "ln1_b": P(None, None),
+        "c_attn": P(None, AXIS_FSDP, AXIS_TENSOR),
+        "attn_proj": P(None, AXIS_TENSOR, AXIS_FSDP),
+        "ln2_w": P(None, None),
+        "ln2_b": P(None, None),
+        "c_fc": P(None, AXIS_FSDP, AXIS_TENSOR),
+        "mlp_proj": P(None, AXIS_TENSOR, AXIS_FSDP),
+    }
+    return {
+        "wte": P(AXIS_TENSOR, AXIS_FSDP),
+        "wpe": P(None, AXIS_FSDP),
+        "layers": layers,
+        "ln_f_w": P(None),
+        "ln_f_b": P(None),
+    }
+
+
 def gpt_bigcode_forward(
     params: Params,
     tokens,
@@ -82,6 +117,7 @@ def gpt_bigcode_forward(
     compute_dtype=jnp.bfloat16,
     positions=None,
     return_embeds: bool = False,
+    mesh=None,
     **_unused,
 ):
     """tokens (B, S) -> logits (B, S, V); optionally also the final hidden
@@ -95,7 +131,18 @@ def gpt_bigcode_forward(
     d, hd = cfg.emb_dim, cfg.head_dim
     if positions is None:
         positions = jnp.arange(s)[None, :]
-    x = params["wte"][tokens] + params["wpe"][positions]
+    # wte is stored P(tensor, fsdp) (gpt_bigcode_param_specs): a direct
+    # gather would hand the activation the table's feature-dim sharding —
+    # the involuntary-full-remat pattern embed_lookup exists to avoid.
+    # wpe is tiny; replicate it before the position slice.
+    from fms_fsdp_tpu.parallel.sharding import constrain, embed_lookup
+
+    wpe = params["wpe"]
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        wpe = constrain(wpe, P(None, None), mesh)
+    x = embed_lookup(params["wte"], tokens, mesh) + wpe[positions]
 
     L = params["layers"]["c_attn"].shape[0]
     for i in range(L):

@@ -193,8 +193,9 @@ def llama_forward(
     # makes GSPMD's param all-gathers move bf16 bytes (the bfSixteen
     # comm-volume behavior, ref:policies/mixed_precision.py:11-15), not fp32.
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
-    x = params["embedding"][tokens]
-    x = _constrain(x, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    from fms_fsdp_tpu.parallel.sharding import embed_lookup
+
+    x = embed_lookup(params["embedding"], tokens, mesh)
 
     # RoPE positions are global; with a context axis the constraint above
     # keeps tokens sharded but positions stay absolute (table is replicated)

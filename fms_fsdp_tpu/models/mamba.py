@@ -220,8 +220,9 @@ def mamba_forward(
     n_layer = len(params["layers"])
     ac_mask = ac_mask if ac_mask is not None else [False] * n_layer
 
-    x = params["embedding"][tokens]
-    x = _constrain(x, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    from fms_fsdp_tpu.parallel.sharding import embed_lookup
+
+    x = embed_lookup(params["embedding"], tokens, mesh)
     residual = x.astype(jnp.float32)  # residual_in_fp32
 
     seq_len = tokens.shape[1]

@@ -111,7 +111,11 @@ def mixtral_param_specs(scan: bool = True) -> Dict[str, Any]:
         "wv": P(*l, AXIS_FSDP, AXIS_TENSOR),
         "wo": P(*l, AXIS_TENSOR, AXIS_FSDP),
         "ffn_norm": P(*l, None),
-        "gate": P(*l, AXIS_FSDP, None),
+        # router weight replicated like the norms: it is trivially small
+        # (D x E), and a D-over-fsdp-sharded router makes SPMD prefer the
+        # (B, S, D) activation D-sharded too — the reshard back to batch
+        # sharding is an involuntary-full-remat in the remat'd backward
+        "gate": P(*l, None, None),
         "w1": P(*l, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
         "w3": P(*l, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
         "w2": P(*l, AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP),
@@ -303,13 +307,19 @@ def _moe_ffn_dispatch(
     return y, _moe_stats(aux, keep)
 
 
-def _use_expert_a2a(cfg: MixtralConfig, mesh: Optional[Mesh]) -> bool:
+def _use_expert_a2a(
+    cfg: MixtralConfig, mesh: Optional[Mesh], batch_size: int
+) -> bool:
     """The explicit all-to-all path applies when the mesh actually has an
-    expert axis to exchange over and it divides the expert count."""
+    expert axis to exchange over, it divides the expert count, and it
+    divides the global batch (every shard_map input is batch-sharded on
+    the expert axis, so a non-divisible batch fails at trace time)."""
     if mesh is None or AXIS_EXPERT not in mesh.shape:
         return False
     ep = int(mesh.shape[AXIS_EXPERT])
-    if ep > 1 and cfg.num_experts % ep != 0:
+    if ep <= 1:
+        return False
+    if cfg.num_experts % ep != 0:
         import warnings
 
         warnings.warn(
@@ -320,7 +330,19 @@ def _use_expert_a2a(cfg: MixtralConfig, mesh: Optional[Mesh]) -> bool:
             " expert_parallel_size dividing num_experts.",
             stacklevel=3,
         )
-    return ep > 1 and cfg.num_experts % ep == 0
+        return False
+    if batch_size % ep != 0:
+        import warnings
+
+        warnings.warn(
+            f"global batch {batch_size} is not divisible by the expert axis"
+            f" extent {ep}: falling back to the GSPMD dispatch. Pick a batch"
+            " size divisible by expert_parallel_size to enable the explicit"
+            " all-to-all EP exchange.",
+            stacklevel=3,
+        )
+        return False
+    return True
 
 
 def _moe_ffn_dispatch_a2a(
@@ -363,6 +385,13 @@ def _moe_ffn_dispatch_a2a(
         xd = lax.all_to_all(
             buf, AXIS_EXPERT, split_axis=0, concat_axis=1, tiled=True
         )  # (E/ep, B*ep, C, D)
+        # pin the token dim to the data axes and D to replicated: without
+        # this, w1's (fsdp, tensor) sharding back-propagates a D-over-fsdp
+        # preference through the buffer scatter into the residual stream,
+        # which GSPMD can only satisfy by involuntary full remat. The
+        # expert dim is manual here, so only auto axes may appear.
+        token_spec = P(None, (AXIS_REPLICA, AXIS_FSDP), None, None)
+        xd = _constrain(xd, token_spec, mesh)
         out = _expert_swiglu(
             xd,
             w1,
@@ -372,6 +401,7 @@ def _moe_ffn_dispatch_a2a(
             # expert dim is manual here; only auto axes may appear
             lambda t: _constrain(t, P(None, None, None, AXIS_TENSOR), mesh),
         )
+        out = _constrain(out, token_spec, mesh)
         out = lax.all_to_all(
             out, AXIS_EXPERT, split_axis=1, concat_axis=0, tiled=True
         )  # (E, B, C, D)
@@ -398,7 +428,9 @@ def _moe_ffn_dispatch_a2a(
     return y, _moe_stats(aux, keep)
 
 
-def _moe_ffn_dispatch_einsum(h, lp, cfg: MixtralConfig, mesh: Optional[Mesh]):
+def _moe_ffn_dispatch_einsum(
+    h, lp, cfg: MixtralConfig, mesh: Optional[Mesh], quant: str = "none"
+):
     """Capacity-based einsum dispatch (GShard style) — oracle path.
 
     Builds (B, S, E, C) one-hot dispatch/combine tensors with first
@@ -425,7 +457,7 @@ def _moe_ffn_dispatch_einsum(h, lp, cfg: MixtralConfig, mesh: Optional[Mesh]):
         combine = combine + d_k * top_w[:, :, k, None, None].astype(h.dtype)
 
     xd = jnp.einsum("bsec,bsd->ebcd", dispatch, h)
-    out_e = _expert_ffn(xd, lp, mesh)
+    out_e = _expert_ffn(xd, lp, mesh, quant)
     y = jnp.einsum("bsec,ebcd->bsd", combine, out_e)
     y = _constrain(y, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
     return y, _moe_stats(aux, keep)
@@ -449,12 +481,12 @@ def _mixtral_block(
 
     h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
     if moe_impl == "dispatch":
-        if _use_expert_a2a(cfg, mesh):
+        if _use_expert_a2a(cfg, mesh, h.shape[0]):
             y, aux = _moe_ffn_dispatch_a2a(h, layer, cfg, mesh, quant)
         else:
             y, aux = _moe_ffn_dispatch(h, layer, cfg, mesh, quant)
     elif moe_impl == "dispatch_einsum":
-        y, aux = _moe_ffn_dispatch_einsum(h, layer, cfg, mesh)
+        y, aux = _moe_ffn_dispatch_einsum(h, layer, cfg, mesh, quant)
     else:
         y, aux = _moe_ffn_dense(h, layer, cfg)
     return x + y, aux
@@ -490,8 +522,9 @@ def mixtral_forward(
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     b, s = tokens.shape
     nlayers = params["layers"]["wq"].shape[0]
-    x = params["embedding"][tokens]
-    x = _constrain(x, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    from fms_fsdp_tpu.parallel.sharding import embed_lookup
+
+    x = embed_lookup(params["embedding"], tokens, mesh)
     cos, sin = rope_table(s, cfg.head_dim, cfg.rope_theta)
 
     block = functools.partial(

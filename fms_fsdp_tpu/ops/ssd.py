@@ -45,34 +45,43 @@ def _segsum(a):
     return jnp.where(mask, diff, -jnp.inf)
 
 
-def _intra_kernel(cum_ref, dt_ref, x_ref, B_ref, C_ref, y_ref, s_ref, cb_ref, *, R):
-    """Per-(batch, head) intra-chunk SSD: the (L, L) decay/score product
-    lives only in VMEM — the HBM-bound part of the XLA formulation
-    (several passes over a (B, L, L, G, R) fp32 tensor per chunk) becomes
-    two MXU matmuls plus fused elementwise work.
+def _fused_kernel(
+    cum_ref, dt_ref, x_ref, B_ref, C_ref, y_ref, cb_ref, state_ref, *, R
+):
+    """Whole-sequence fused SSD: intra-chunk matmuls AND the inter-chunk
+    recurrence in one kernel.
 
-    Operands arrive head-major — x (B, H, L, P), B/C (B, G, L, N), and
-    cum/dt (B, H, 1, L) where cum is the chunk-local cumsum of the
+    Grid is (batch, group, chunk, head-in-group) with the chunk/head dims
+    sequential: each head's (N, P) fp32 state lives in persistent VMEM
+    scratch (``state_ref``, one slot per group member) and is carried
+    across the chunk sweep — the round-2 design ran one pallas_call per
+    chunk under ``lax.scan`` and paid a head-major relayout of every
+    operand per chunk plus the scan/dispatch overhead; measured 2x
+    slower than the XLA einsums (BENCH_SSD.json r2). Fusing the scan
+    into the grid removes both, and the (L, L) decay/score product still
+    never leaves VMEM.
+
+    Operands arrive head-major — x (B, H, S, P), B/C (B, G, S, N), and
+    cum/dt (B, H, 1, S) where cum is the *chunk-local* cumsum of the
     per-token log-decay a (precomputed host-side: cumsum has no Pallas
-    TPU lowering) — so every block's trailing two dims equal the array
-    dims (the Mosaic lowering requires trailing block dims divisible by
-    (8, 128) or whole; the natural (B, L, H, P) layout puts a size-1 head
-    dim second-to-last and fails to lower).
+    TPU lowering) — so every block's trailing two dims are whole or
+    (8, 128)-divisible (the natural (B, L, H, P) layout puts a size-1
+    head dim second-to-last and fails to lower; r2 hard-won fact).
 
-    C@B^T is shared by every head in a GQA group; the grid walks heads
-    fastest, so it is computed once per group into persistent VMEM
-    scratch (``cb_ref``) and reused by the group's other R-1 heads (the
-    B/C input blocks themselves are fetched once per group — their index
-    map is constant across the group)."""
+    C@B^T is shared by every head in a GQA group; heads walk fastest, so
+    it is computed once per (b, g, chunk) into ``cb_ref`` and reused by
+    the group's other R-1 heads (the B/C input blocks themselves are
+    fetched once per chunk — their index map is constant across heads).
+    """
     L = x_ref.shape[2]
-    h = pl.program_id(1)
-    # cum = cumsum of the per-token log-decay a, precomputed host-side
-    # (cumsum has no Pallas TPU lowering)
-    cum = cum_ref[0, 0]  # (1, L) fp32
+    ci = pl.program_id(2)
+    r = pl.program_id(3)
+    cum = cum_ref[0, 0]  # (1, L) fp32, chunk-local cumsum
     dt = dt_ref[0, 0]  # (1, L) fp32
     x = x_ref[0, 0]  # (L, P) input dtype
     B = B_ref[0, 0]  # (L, N)
     C = C_ref[0, 0]  # (L, N)
+    od = x.dtype
 
     cum_col = jnp.transpose(cum)  # (L, 1)
     seg = cum_col - cum  # (L, L): cum_i - cum_j
@@ -81,27 +90,41 @@ def _intra_kernel(cum_ref, dt_ref, x_ref, B_ref, C_ref, y_ref, s_ref, cb_ref, *,
     )
     decay = jnp.exp(jnp.where(mask, seg, NEG_INF))
 
-    @pl.when(h % R == 0)
+    @pl.when(r == 0)
     def _():
         cb_ref[...] = jax.lax.dot_general(
             C, B, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (L, L)
 
+    @pl.when(ci == 0)
+    def _():
+        state_ref[pl.ds(r, 1)] = jnp.zeros_like(state_ref[pl.ds(r, 1)])
+
     w = cb_ref[...] * decay * dt  # dt broadcasts over rows (j axis)
     y = jax.lax.dot_general(
-        w.astype(x.dtype), x, (((1,), (0,)), ((), ())),
+        w.astype(od), x, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # (L, P)
+    )  # (L, P) intra-chunk
 
+    # inter-chunk output: exp(cum_i) * C_i . s_prev
+    s_prev = state_ref[pl.ds(r, 1)][0]  # (N, P) fp32
+    y = y + jnp.exp(cum_col) * jax.lax.dot_general(
+        C,
+        s_prev.astype(od),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    # state update: s_new = exp(total) * s_prev + B^T (x * decay-to-end)
     total = cum[:, L - 1 :]  # (1, 1)
-    r = (jnp.exp(total - cum) * dt).astype(x.dtype)  # (1, L)
-    xs = x * jnp.transpose(r)  # (L, P)
-    s = jax.lax.dot_general(
+    rdec = (jnp.exp(total - cum) * dt).astype(od)  # (1, L)
+    xs = x * jnp.transpose(rdec)  # (L, P)
+    contrib = jax.lax.dot_general(
         B, xs, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # (N, P)
+    state_ref[pl.ds(r, 1)] = (jnp.exp(total) * s_prev + contrib)[None]
 
     y_ref[0, 0] = y
-    s_ref[0, 0] = s
 
 
 def _intra_and_states_xla(xc, dtc, ac, Bc, Cc, G):
@@ -139,70 +162,82 @@ def _intra_and_states_xla(xc, dtc, ac, Bc, Cc, G):
     return y, states
 
 
-def _intra_and_states_pallas_fwd(xc, dtc, ac, Bc, Cc, G, interpret):
-    Bsz, L, H, P = xc.shape
-    N = Bc.shape[-1]
+def _ssd_core_pallas_fwd(x, dtf, a, Bm, Cm, L, interpret):
+    """Fused whole-sequence forward. x (B, S, H, P) input dtype; dtf/a
+    (B, S, H) fp32; Bm/Cm (B, S, G, N) input dtype. Returns y (B, S, H, P)
+    fp32 (no D term)."""
+    Bsz, S, H, P = x.shape
+    G, N = Bm.shape[2], Bm.shape[3]
     R = H // G
-    cum_rows = jnp.moveaxis(jnp.cumsum(ac, axis=1), 1, 2)[:, :, None, :]  # (B,H,1,L)
-    dt_rows = jnp.moveaxis(dtc, 1, 2)[:, :, None, :]
-    xh = jnp.moveaxis(xc, 1, 2)  # (B, H, L, P)
-    Bh = jnp.moveaxis(Bc, 1, 2)  # (B, G, L, N)
-    Ch = jnp.moveaxis(Cc, 1, 2)
+    C = S // L
 
-    y, s = pl.pallas_call(
-        functools.partial(_intra_kernel, R=R),
-        grid=(Bsz, H),
+    # chunk-local cumsum of the log-decay, then head-major views (one
+    # relayout for the whole sequence — not one per chunk)
+    cum = jnp.cumsum(a.reshape(Bsz, C, L, H), axis=2).reshape(Bsz, S, H)
+    cum_rows = jnp.moveaxis(cum, 1, 2)[:, :, None, :]  # (B, H, 1, S) fp32
+    dt_rows = jnp.moveaxis(dtf, 1, 2)[:, :, None, :]
+    xh = jnp.moveaxis(x, 1, 2)  # (B, H, S, P)
+    Bh = jnp.moveaxis(Bm, 1, 2)  # (B, G, S, N)
+    Ch = jnp.moveaxis(Cm, 1, 2)
+
+    y = pl.pallas_call(
+        functools.partial(_fused_kernel, R=R),
+        grid=(Bsz, G, C, R),
         in_specs=[
-            pl.BlockSpec((1, 1, 1, L), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, 1, L), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, L, P), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, L, N), lambda b, h, R=R: (b, h // R, 0, 0)),
-            pl.BlockSpec((1, 1, L, N), lambda b, h, R=R: (b, h // R, 0, 0)),
+            pl.BlockSpec((1, 1, 1, L), lambda b, g, ci, r, R=R: (b, g * R + r, 0, ci)),
+            pl.BlockSpec((1, 1, 1, L), lambda b, g, ci, r, R=R: (b, g * R + r, 0, ci)),
+            pl.BlockSpec((1, 1, L, P), lambda b, g, ci, r, R=R: (b, g * R + r, ci, 0)),
+            pl.BlockSpec((1, 1, L, N), lambda b, g, ci, r: (b, g, ci, 0)),
+            pl.BlockSpec((1, 1, L, N), lambda b, g, ci, r: (b, g, ci, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, 1, L, P), lambda b, h: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, N, P), lambda b, h: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, L, P), lambda b, g, ci, r, R=R: (b, g * R + r, ci, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((Bsz, H, S, P), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((L, L), jnp.float32),  # shared C@B^T per (b,g,chunk)
+            pltpu.VMEM((R, N, P), jnp.float32),  # per-head carried state
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((Bsz, H, L, P), jnp.float32),
-            jax.ShapeDtypeStruct((Bsz, H, N, P), jnp.float32),
-        ],
-        scratch_shapes=[pltpu.VMEM((L, L), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            # state/cb scratch carry across (chunk, head) — sequential;
+            # batch/group cells are independent
+            dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary")
+        ),
         interpret=interpret,
     )(cum_rows, dt_rows, xh, Bh, Ch)
-    return jnp.moveaxis(y, 1, 2), jnp.swapaxes(s, 2, 3)  # (B,L,H,P), (B,H,P,N)
+    return jnp.moveaxis(y, 1, 2)  # (B, S, H, P) fp32
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def _intra_and_states_pallas(xc, dtc, ac, Bc, Cc, G, interpret):
-    return _intra_and_states_pallas_fwd(xc, dtc, ac, Bc, Cc, G, interpret)
+def _ssd_core_pallas(x, dtf, a, Bm, Cm, L, interpret):
+    return _ssd_core_pallas_fwd(x, dtf, a, Bm, Cm, L, interpret)
 
 
-def _intra_pallas_fwd_rule(xc, dtc, ac, Bc, Cc, G, interpret):
-    out = _intra_and_states_pallas_fwd(xc, dtc, ac, Bc, Cc, G, interpret)
-    return out, (xc, dtc, ac, Bc, Cc)
+def _ssd_core_pallas_fwd_rule(x, dtf, a, Bm, Cm, L, interpret):
+    out = _ssd_core_pallas_fwd(x, dtf, a, Bm, Cm, L, interpret)
+    return out, (x, dtf, a, Bm, Cm)
 
 
-def _intra_pallas_bwd_rule(G, interpret, res, cots):
-    # backward recomputes through the XLA formulation — one chunk's
-    # (L, L)-per-head intermediates at a time (the scan body is already
-    # checkpointed), exact same math as the kernel
-    xc, dtc, ac, Bc, Cc = res
+def _ssd_core_pallas_bwd_rule(L, interpret, res, cot):
+    # backward recomputes through the XLA formulation — the checkpointed
+    # chunk scan re-materializes one chunk's (L, L)-per-head
+    # intermediates at a time; exact same math as the kernel
+    x, dtf, a, Bm, Cm = res
     _, vjp = jax.vjp(
-        lambda *args: _intra_and_states_xla(*args, G), xc, dtc, ac, Bc, Cc
+        lambda *args: _ssd_core_xla(*args, L), x, dtf, a, Bm, Cm
     )
-    return vjp(cots)
+    return vjp(cot)
 
 
-_intra_and_states_pallas.defvjp(_intra_pallas_fwd_rule, _intra_pallas_bwd_rule)
+_ssd_core_pallas.defvjp(_ssd_core_pallas_fwd_rule, _ssd_core_pallas_bwd_rule)
 
 
-def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G, kernel="xla"):
-    """One chunk of the SSD scan. The intra-chunk quadratic term and the
-    chunk's state contribution come from either the Pallas kernel (the
-    (L, L)-per-head decay never leaves VMEM) or the group-factored XLA
-    einsums (heads carried as (G, R) dot_general batching — no
-    head-repeated (L, H, N) or (L, L, H) tensor, the round-1 memory hog).
+def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G):
+    """One chunk of the SSD scan (XLA formulation; also the recompute
+    backward of the fused Pallas kernel). Intra-chunk quadratic term and
+    state contribution via group-factored einsums (heads carried as
+    (G, R) dot_general batching — no head-repeated (L, H, N) or
+    (L, L, H) tensor, the round-1 memory hog).
 
     Mixed precision mirrors the mamba_ssm CUDA kernels: matmul operands
     stay in the input dtype (bf16 under training — fp32 MXU matmuls run
@@ -222,12 +257,7 @@ def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G, kernel="xla"):
     cum = jnp.cumsum(ac, axis=1)  # (B, L, H)
     total = cum[:, -1:, :]  # (B, 1, H)
 
-    if kernel == "pallas":
-        y, states = _intra_and_states_pallas(
-            xc, dtc, ac, Bc, Cc, G, jax.default_backend() == "cpu"
-        )
-    else:
-        y, states = _intra_and_states_xla(xc, dtc, ac, Bc, Cc, G)
+    y, states = _intra_and_states_xla(xc, dtc, ac, Bc, Cc, G)
 
     # inter-chunk output: exp(cum_i) * C_i . s_prev, grouped over (b, g)
     y = y + (
@@ -260,6 +290,33 @@ def ssd_scan(x, dt, A, Bm, Cm, D=None, chunk_size: int = 256, kernel: str = "aut
     dtf = dt.astype(jnp.float32)
     a = dtf * A.astype(jnp.float32)[None, None, :]  # (B, S, H), <= 0
 
+    assert kernel in ("auto", "xla", "pallas"), f"unknown ssd kernel {kernel!r}"
+    # "auto" resolves to the XLA formulation until the fused kernel is
+    # re-measured on chip (the r2 per-chunk kernel measured 2x slower
+    # than the einsums — BENCH_SSD.json; the fused whole-sequence kernel
+    # above removes the per-chunk relayouts + scan overhead it paid).
+    mode = "xla" if kernel == "auto" else kernel
+
+    if mode == "pallas":
+        y = _ssd_core_pallas(
+            x, dtf, a, Bm, Cm, L, jax.default_backend() == "cpu"
+        )
+    else:
+        y = _ssd_core_xla(x, dtf, a, Bm, Cm, L)
+
+    if D is not None:
+        y = y + D.astype(jnp.float32)[None, None, :, None] * x.astype(jnp.float32)
+
+    return y.astype(x.dtype)
+
+
+def _ssd_core_xla(x, dtf, a, Bm, Cm, L):
+    """Checkpointed chunk scan over the XLA einsum formulation.
+    Returns y (B, S, H, P) fp32 (no D term)."""
+    Bsz, S, H, P = x.shape
+    G, N = Bm.shape[2], Bm.shape[3]
+    C = S // L
+
     # chunked views, chunk axis leading for the scan; matmul operands stay
     # in the input dtype, decay stats in fp32
     xc = jnp.moveaxis(x.reshape(Bsz, C, L, H, P), 1, 0)
@@ -268,30 +325,14 @@ def ssd_scan(x, dt, A, Bm, Cm, D=None, chunk_size: int = 256, kernel: str = "aut
     Bc = jnp.moveaxis(Bm.reshape(Bsz, C, L, G, N), 1, 0)
     Cc = jnp.moveaxis(Cm.reshape(Bsz, C, L, G, N), 1, 0)
 
-    assert kernel in ("auto", "xla", "pallas"), f"unknown ssd kernel {kernel!r}"
-    # "auto" resolves to the XLA formulation: measured on a real v5e at
-    # mamba-9.8b shapes (B=2, S=4096, H=128, P=64, G=1, N=128) the
-    # group-factored einsums run ~2x faster than the Pallas intra-chunk
-    # kernel, fwd and grad (BENCH_SSD.json for the numbers) — the
-    # per-(b,h) grid does tiny (256,256)@(256,64) matmuls and pays
-    # head-major relayouts per chunk, and XLA fuses the einsum path well.
-    # "pallas" stays available (exact parity on chip) as the base for a
-    # future chunk-fused kernel.
-    mode = "xla" if kernel == "auto" else kernel
-
     @functools.partial(jax.checkpoint, prevent_cse=False)
     def body(s, inp):
-        y_c, s_new = _ssd_chunk(s, *inp, G, kernel=mode)
+        y_c, s_new = _ssd_chunk(s, *inp, G)
         return s_new, y_c
 
     init = jnp.zeros((Bsz, H, P, N), jnp.float32)
     _, ys = lax.scan(body, init, (xc, dtc, ac, Bc, Cc))
-    y = jnp.moveaxis(ys, 0, 1).reshape(Bsz, S, H, P)
-
-    if D is not None:
-        y = y + D.astype(jnp.float32)[None, None, :, None] * x.astype(jnp.float32)
-
-    return y.astype(x.dtype)
+    return jnp.moveaxis(ys, 0, 1).reshape(Bsz, S, H, P)
 
 
 def ssd_scan_reference(x, dt, A, Bm, Cm, D=None):

@@ -37,6 +37,29 @@ def batch_pspec() -> P:
     return P(DATA_AXES, AXIS_CONTEXT)
 
 
+def embed_lookup(table, tokens, mesh: Optional[Mesh]):
+    """Token-embedding gather that partitions cleanly.
+
+    The table is stored P(tensor, fsdp). A direct ``table[tokens]`` makes
+    the gather output inherit the table's feature-dim (fsdp) sharding,
+    and the subsequent reshard to batch sharding is one GSPMD cannot do
+    efficiently — it falls back to "involuntary full rematerialization"
+    (replicate the whole (B, S, D) activation, then re-partition).
+
+    Constraining the table to P(tensor, None) *before* the gather moves
+    the all-gather to the table weight (the same bytes FSDP all-gathers
+    for every other layer's weights) and keeps the vocab dim sharded
+    over tensor, which GSPMD partitions with the standard clamp + select
+    + psum trick; the output is then born batch-sharded with the feature
+    dim replicated — exactly the layout the model constrains `x` to.
+    """
+    if mesh is None:
+        return table[tokens]
+    table = constrain(table, P(AXIS_TENSOR, None), mesh)
+    x = table[tokens]
+    return constrain(x, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+
+
 def llama_param_specs(scan: bool = True) -> Dict[str, Any]:
     """Spec tree matching the Llama param tree (models/llama.py).
 

@@ -78,7 +78,8 @@ def _per_head_ce(preds, targets_fn):
 
 
 def make_stage1_step(
-    base_params, model_cfg, scfg: SpeculatorConfig, cfg, optimizer, base_api=None
+    base_params, model_cfg, scfg: SpeculatorConfig, cfg, optimizer,
+    base_api=None, mesh=None,
 ):
     """(spec_state, input (B, L)) -> (spec_state, metrics). Ground-truth
     feed: embeds over input[:, :-n-1], head i scored against
@@ -98,6 +99,7 @@ def make_stage1_step(
             model_cfg,
             attn_impl=cfg.attention_kernel,
             quant=quant,
+            mesh=mesh,
         )
         embeds = jax.lax.stop_gradient(embeds)
         preds = speculator_forward(spec_params, embeds, inputs[:, 1:], scfg)
@@ -222,6 +224,7 @@ def train_speculator(
     profiler=None,
     ckpt_loader=None,
     base_api=None,
+    mesh=None,
 ):
     """Speculator host loop with the reference's reporting/ckpt cadence
     (ref:train_speculator_utils.py:263-427). ``train_loader`` yields global
@@ -229,7 +232,7 @@ def train_speculator(
     pipeline object whose state gets checkpointed (defaults to
     train_loader when it exposes save_to_path)."""
     stage1 = make_stage1_step(
-        base_params, model_cfg, scfg, cfg, optimizer, base_api
+        base_params, model_cfg, scfg, cfg, optimizer, base_api, mesh=mesh
     )
     stage2 = None  # built lazily: its batch-partition constraints only
     # apply once stage 2 actually starts

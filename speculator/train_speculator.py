@@ -34,7 +34,7 @@ from fms_fsdp_tpu.parallel.mesh import (
     build_mesh,
     data_parallel_extent,
 )
-from fms_fsdp_tpu.parallel.sharding import llama_param_specs, shard_params
+from fms_fsdp_tpu.parallel.sharding import shard_params
 from fms_fsdp_tpu.train.speculator import (
     make_speculator_optimizer,
     train_speculator,
@@ -103,11 +103,7 @@ def main(**kwargs):
                 print(f"model_arch={cfg.model_arch} overridden by HF "
                       f"checkpoint arch {arch}")
             base_api = get_base_api(arch)
-        base_params = shard_params(
-            base_params,
-            llama_param_specs() if arch == "llama" else None,
-            mesh,
-        )
+        base_params = shard_params(base_params, base_api.param_specs(), mesh)
     else:
         if base_api.arch == "llama":
             model_cfg = get_model_config(cfg.model_variant)
@@ -124,11 +120,7 @@ def main(**kwargs):
         base_params = base_api.init(
             jax.random.PRNGKey(cfg.seed), model_cfg, dtype=jnp.bfloat16
         )
-        base_params = shard_params(
-            base_params,
-            llama_param_specs() if base_api.arch == "llama" else None,
-            mesh,
-        )
+        base_params = shard_params(base_params, base_api.param_specs(), mesh)
         if cfg.model_path and os.path.exists(cfg.model_path):
             loader_ck = Checkpointer(
                 os.path.join(cfg.ckpt_save_path, "_base_load"), 1, "ddp", rank
@@ -201,6 +193,7 @@ def main(**kwargs):
         profiler,
         ckpt_loader=ckpt_loader,
         base_api=base_api,
+        mesh=mesh,
     )
 
 

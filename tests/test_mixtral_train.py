@@ -153,7 +153,11 @@ def test_a2a_dispatch_matches_plain_dispatch():
     cfg = _tiny_cfg(capacity_factor=0.5)
     tc = _train_cfg(expert_parallel_size=2)
     mesh = build_mesh(MeshConfig.from_train_config(tc))
-    assert _use_expert_a2a(cfg, mesh)
+    assert _use_expert_a2a(cfg, mesh, 8)
+    # non-divisible global batch must fall back (shard_map would fail at
+    # trace time), with a warning naming the fix
+    with pytest.warns(UserWarning, match="not divisible by the expert axis"):
+        assert not _use_expert_a2a(cfg, mesh, 7)
     B, S, D = 8, 16, cfg.emb_dim
     h = jax.random.normal(jax.random.PRNGKey(0), (B, S, D), jnp.float32)
     lp = _random_moe_layer(jax.random.PRNGKey(1), cfg, D)
