@@ -193,6 +193,7 @@ def main():
 
     result = {
         "metric": "host dataloader throughput (1 process)",
+        "host_cpus": os.cpu_count(),
         "rows": rows,
         # headline keeps the arrow production-path number
         "tokens_per_sec": rows[0]["tokens_per_sec"],
