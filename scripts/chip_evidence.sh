@@ -1,0 +1,75 @@
+#!/bin/bash
+# One-pass capture of all chip-side evidence, safe to run unattended the
+# moment the TPU tunnel comes back:
+#   1. bench.py            -> CHIP_BENCH.json (all MFU rows, watchdogged)
+#   2. bench_kernels.py    -> BENCH_KERNELS.json (flash fwd/bwd, ring
+#                             partials, int8/bf16 matmul ceilings)
+#   3. bench_ssd.py        -> BENCH_SSD.json (fused SSD kernel vs XLA)
+#   4. 194m training run on the learnable dummy stream + eval_ppl
+#                          -> EVAL.json
+# Every step is timeout-guarded and failure-isolated; the script always
+# runs to the end and prints a summary of what was captured.
+set -u
+cd "$(dirname "$0")/.."
+log() { echo "[chip_evidence $(date +%H:%M:%S)] $*"; }
+
+log "probing chip"
+if ! timeout 90 python -c "import jax, jax.numpy as jnp; print(float(jnp.sum(jax.jit(lambda a: a@a)(jnp.ones((8,8))))))" 2>/dev/null; then
+    log "chip unavailable - aborting (nothing written)"
+    exit 1
+fi
+log "chip is up"
+
+log "1/4 bench.py (full row sweep, subprocess watchdogs)"
+timeout 7500 python bench.py | tee CHIP_BENCH.json || log "bench.py failed"
+
+log "2/4 bench_kernels.py"
+timeout 2400 python scripts/bench_kernels.py || log "bench_kernels failed"
+
+log "3/4 bench_ssd.py"
+timeout 2400 python scripts/bench_ssd.py || log "bench_ssd failed"
+
+log "4/4 eval: train llama3_194m on the learnable dummy stream, then eval_ppl"
+rm -rf /tmp/eval_ckpt
+timeout 2400 python -u main_training_llama.py --use_dummy_dataset=True \
+    --num_steps=600 --report_interval=100 --checkpoint_interval=600 \
+    --ckpt_save_path=/tmp/eval_ckpt --ckpt_load_path=/tmp/eval_ckpt \
+    --model_variant=llama3_194m_4k --batch_size=4 --seq_length=4096 \
+    --fsdp_activation_checkpointing=True --selective_checkpointing=0.5 \
+    > /tmp/eval_train.log 2>&1 || log "eval training failed"
+tail -n 3 /tmp/eval_train.log
+timeout 1200 python eval_ppl.py --use_dummy_dataset=True --eval_batches=16 \
+    --ckpt_load_path=/tmp/eval_ckpt --model_variant=llama3_194m_4k \
+    --batch_size=4 --seq_length=4096 > /tmp/eval_ppl.json 2>/tmp/eval_ppl.err \
+    || log "eval_ppl failed"
+python - <<'EOF' || true
+import json
+
+line = None
+try:
+    with open("/tmp/eval_ppl.json") as f:
+        lines = [l for l in f.read().splitlines() if l.strip().startswith("{")]
+    line = lines[-1] if lines else None
+except OSError:
+    pass
+if line:
+    r = json.loads(line)
+    r["setup"] = (
+        "llama3_194m_4k trained 600 steps (bs=4, seq=4096, ~9.8M tokens) on "
+        "the deterministic SteadyCounter dummy stream on one v5e chip, then "
+        "evaluated in place with eval_ppl.py (params-only sharded load). The "
+        "stream is learnable-but-held-in: this evidences the train->checkpoint"
+        "->native-eval path end to end; corpus-level quality parity needs the "
+        "multi-pod 2T-token run (docs/evaluation.md)."
+    )
+    with open("EVAL.json", "w") as f:
+        json.dump(r, f, indent=1)
+    print("EVAL.json:", json.dumps(r)[:160])
+else:
+    print("no eval_ppl output; EVAL.json not written")
+EOF
+
+log "done; captured:"
+for f in CHIP_BENCH.json BENCH_KERNELS.json BENCH_SSD.json EVAL.json; do
+    [ -f "$f" ] && echo "  $f: $(head -c 120 "$f")"
+done
