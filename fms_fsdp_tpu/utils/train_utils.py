@@ -62,7 +62,27 @@ def setup():
         )
     )
     if multihost:
-        jax.distributed.initialize()
+        coord = os.environ.get("COORDINATOR_ADDRESS")
+        if coord and "NUM_PROCESSES" in os.environ:
+            # explicit env-driven init (torch env:// analog: MASTER_ADDR/
+            # WORLD_SIZE/RANK -> COORDINATOR_ADDRESS/NUM_PROCESSES/
+            # PROCESS_ID). jax's argless auto-detect only covers managed
+            # launchers (Slurm/OMPI/TPU pods/K8s) — a hand-launched or
+            # custom-orchestrated world must pass the triple explicitly.
+            if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+                # cross-process collectives on CPU need a real backend;
+                # gloo is the XLA:CPU implementation (tested by
+                # tests/test_multiprocess.py on a 2-process world)
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo"
+                )
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(os.environ["NUM_PROCESSES"]),
+                process_id=int(os.environ["PROCESS_ID"]),
+            )
+        else:
+            jax.distributed.initialize()
 
 
 def setup_environ_flags():

@@ -1,0 +1,44 @@
+"""Child process for tests/test_multiprocess.py: one rank of a 2-process
+CPU world (4 virtual devices each) running the real llama training entry.
+
+Env contract (set by the parent test): JAX_PLATFORMS=cpu, XLA_FLAGS with
+xla_force_host_platform_device_count=4, COORDINATOR_ADDRESS,
+NUM_PROCESSES, PROCESS_ID. Everything else — distributed init (gloo CPU
+collectives), mesh build over the 8-device global world, sharded state
+init, DeviceFeed's make_array_from_process_local_data assembly, the
+jitted train step's cross-process collectives, and the Orbax
+multi-process checkpoint commit at the final step — is the production
+code path in main_training_llama.main.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import main_training_llama as entry
+
+if __name__ == "__main__":
+    ckpt_dir = sys.argv[1]
+    entry.main(
+        use_dummy_dataset=True,
+        num_steps=6,
+        report_interval=2,
+        checkpoint_interval=6,  # exercise the multi-process Orbax commit
+        ckpt_save_path=ckpt_dir,
+        ckpt_load_path=ckpt_dir,
+        batch_size=2,
+        seq_length=64,
+        vocab_size=256,
+        sharding_strategy="fsdp",
+        **{
+            "LlamaConfig.nlayers": 2,
+            "LlamaConfig.emb_dim": 128,
+            "LlamaConfig.nheads": 4,
+            "LlamaConfig.kvheads": 2,
+            "LlamaConfig.src_vocab_size": 256,
+            "LlamaConfig.multiple_of": 16,
+            "LlamaConfig.max_expected_seq_len": 64,
+        },
+    )
+    print("MP_CHILD_DONE", flush=True)
