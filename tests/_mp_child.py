@@ -16,29 +16,66 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import main_training_llama as entry
+COMMON = dict(
+    use_dummy_dataset=True,
+    num_steps=6,
+    report_interval=2,
+    checkpoint_interval=6,  # exercise the multi-process Orbax commit
+    batch_size=2,
+    seq_length=64,
+    vocab_size=256,
+)
+
+LLAMA_TINY = {
+    "LlamaConfig.nlayers": 2,
+    "LlamaConfig.emb_dim": 128,
+    "LlamaConfig.nheads": 4,
+    "LlamaConfig.kvheads": 2,
+    "LlamaConfig.src_vocab_size": 256,
+    "LlamaConfig.multiple_of": 16,
+    "LlamaConfig.max_expected_seq_len": 64,
+}
+
+MIXTRAL_TINY = {
+    "MixtralConfig.nlayers": 2,
+    "MixtralConfig.emb_dim": 128,
+    "MixtralConfig.nheads": 4,
+    "MixtralConfig.kvheads": 2,
+    "MixtralConfig.hidden_dim": 96,
+    "MixtralConfig.num_experts": 4,
+    "MixtralConfig.top_k": 2,
+    "MixtralConfig.src_vocab_size": 256,
+    "MixtralConfig.max_expected_seq_len": 64,
+}
 
 if __name__ == "__main__":
-    ckpt_dir = sys.argv[1]
-    entry.main(
-        use_dummy_dataset=True,
-        num_steps=6,
-        report_interval=2,
-        checkpoint_interval=6,  # exercise the multi-process Orbax commit
-        ckpt_save_path=ckpt_dir,
-        ckpt_load_path=ckpt_dir,
-        batch_size=2,
-        seq_length=64,
-        vocab_size=256,
-        sharding_strategy="fsdp",
-        **{
-            "LlamaConfig.nlayers": 2,
-            "LlamaConfig.emb_dim": 128,
-            "LlamaConfig.nheads": 4,
-            "LlamaConfig.kvheads": 2,
-            "LlamaConfig.src_vocab_size": 256,
-            "LlamaConfig.multiple_of": 16,
-            "LlamaConfig.max_expected_seq_len": 64,
-        },
-    )
+    ckpt_dir, mode = sys.argv[1], sys.argv[2]
+    kw = dict(COMMON, ckpt_save_path=ckpt_dir, ckpt_load_path=ckpt_dir)
+    if mode == "fsdp":
+        import main_training_llama as entry
+
+        kw.update(sharding_strategy="fsdp", **LLAMA_TINY)
+    elif mode == "cp":
+        # ring attention's ppermute crossing the process boundary
+        import main_training_llama as entry
+
+        kw.update(
+            sharding_strategy="fsdp",
+            context_parallel_size=2,
+            attention_kernel="xla",
+            **LLAMA_TINY,
+        )
+    elif mode == "ep":
+        # MoE expert-parallel all-to-all crossing the process boundary
+        import main_training_mixtral as entry
+
+        kw.update(
+            sharding_strategy="fsdp",
+            expert_parallel_size=2,
+            attention_kernel="xla",
+            **MIXTRAL_TINY,
+        )
+    else:
+        raise SystemExit(f"unknown mode {mode!r}")
+    entry.main(**kw)
     print("MP_CHILD_DONE", flush=True)

@@ -27,9 +27,13 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_fsdp_train(tmp_path):
+@pytest.mark.parametrize("mode", ["fsdp", "cp", "ep"])
+def test_two_process_train(tmp_path, mode):
     # wall-clock bound: the communicate(timeout=840) below kills both
-    # ranks on a hang (pytest-timeout isn't installed in this image)
+    # ranks on a hang (pytest-timeout isn't installed in this image).
+    # Modes: fsdp = cross-process param all-gather/reduce-scatter;
+    # cp = ring attention's ppermute across the process boundary;
+    # ep = the MoE expert-parallel all-to-all across the process boundary.
     port = _free_port()
     ckpt = str(tmp_path / "ckpt")
     procs = []
@@ -44,7 +48,7 @@ def test_two_process_fsdp_train(tmp_path):
         )
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-u", CHILD, ckpt],
+                [sys.executable, "-u", CHILD, ckpt, mode],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 text=True,
