@@ -64,14 +64,25 @@ def main():
             }
         )
 
-    for mode in ("xla", "pallas"):
-        fwd = functools.partial(ssd_scan, kernel=mode)
+    # chunk sweep for both formulations: the fused kernel's VMEM residency
+    # ((L, L) decay product + per-group state) and the XLA path's
+    # materialized (B, L, L, G, R) weight tensor trade off differently
+    # with L, so the shipped "auto" choice is the measured best pair
+    for mode, chunk in (
+        ("xla", 128),
+        ("xla", 256),
+        ("xla", 512),
+        ("pallas", 128),
+        ("pallas", 256),
+        ("pallas", 512),
+    ):
+        fwd = functools.partial(ssd_scan, kernel=mode, chunk_size=chunk)
 
         def loss(x, dt, A, Bm, Cm, D, fwd=fwd):
             return jnp.sum(fwd(x, dt, A, Bm, Cm, D).astype(jnp.float32))
 
         add(
-            f"ssd_scan[{mode}]",
+            f"ssd_scan[{mode},L={chunk}]",
             fwd,
             jax.grad(loss, argnums=(0, 1, 3, 4)),
             (x, dt, A, Bm, Cm, D),
@@ -89,7 +100,7 @@ def main():
 
     out = {
         "shapes": (
-            f"SSD: B={B} S={S} H={H} P={P} G={G} N={N} chunk=256 bf16; "
+            f"SSD: B={B} S={S} H={H} P={P} G={G} N={N} chunk swept bf16; "
             f"conv1d: C={CONV_C} W={CONV_W}"
         ),
         "chip": os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"),
