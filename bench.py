@@ -56,6 +56,12 @@ def run_config(
         # sitecustomize pins the axon TPU platform before env vars are
         # read; only jax.config reliably redirects to CPU (NOTES.md).
         jax.config.update("jax_platforms", "cpu")
+    smoke = bool(os.environ.get("BENCH_SMOKE"))
+    if smoke:
+        # end-to-end plumbing check (parent -> row subprocess -> JSON
+        # aggregation) at CPU-feasible sizes; the MFU values it reports
+        # are meaningless and main() labels the output accordingly
+        seq_length, batch_size, steps, reps = 256, 1, 2, 1
     import jax.numpy as jnp
 
     from fms_fsdp_tpu.config import TrainConfig
@@ -88,6 +94,20 @@ def run_config(
     model_cfg = get_model_config(variant)
     if model_overrides:
         model_cfg = dataclasses.replace(model_cfg, **model_overrides)
+    if smoke:
+        shrink = {
+            "nlayers": 1, "n_layer": 1, "emb_dim": 256, "d_model": 256,
+            "nheads": 4, "kvheads": 2, "hidden_dim": 384,
+            "src_vocab_size": 512, "vocab_size": 512,
+        }
+        model_cfg = dataclasses.replace(
+            model_cfg,
+            **{
+                k: v
+                for k, v in shrink.items()
+                if any(f.name == k for f in dataclasses.fields(model_cfg))
+            },
+        )
     mesh = build_mesh(MeshConfig.from_train_config(cfg))
     opt = make_optimizer(cfg)
     state, _ = init_train_state(jax.random.PRNGKey(0), model_cfg, cfg, mesh, opt)
@@ -345,6 +365,9 @@ def main():
     }
     if "error" in head:
         result["error"] = head["error"]
+    if os.environ.get("BENCH_SMOKE"):
+        result["smoke"] = True
+        result["metric"] = "SMOKE (plumbing check at tiny shapes) " + result["metric"]
     print(json.dumps(result))
 
 
