@@ -24,6 +24,7 @@ blocks — see parallel/ring.py.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -116,7 +117,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k, causal):
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q: (B, Nq, Sq, H); k/v: (B, Nkv, Sk, H) -> (o, lse)."""
+    """q: (B, Nq, Sq, H); k/v: (B, Nkv, Sk, H) -> (o, lse).
+
+    Two implementations (identical math/contract): the kv-resident
+    fori_loop kernel below, and the kv-streamed grid kernel
+    (_fwd_kernel_kvgrid). FLASH_FWD_VARIANT=kvgrid selects the latter —
+    raced on chip by scripts/bench_kernels.py."""
+    if os.environ.get("FLASH_FWD_VARIANT", "resident") == "kvgrid":
+        return _flash_fwd_kvgrid(
+            q, k, v, scale, causal, block_q, block_k, interpret
+        )
     batch, nq, seq_q, head = q.shape
     nkv, seq_k = k.shape[1], k.shape[2]
     group = nq // nkv
@@ -153,6 +163,140 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         # steps): telling Mosaic lets it pipeline/partition freely
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel")
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+def _fwd_kernel_kvgrid(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, scale, causal, num_kb,
+):
+    """kv-streamed forward: grid (b, h, qi, ki), one kv block per cell.
+
+    The resident kernel above stages the whole per-head kv stream in VMEM
+    and walks it with fori_loop — VMEM residency O(S), hard sequence cap
+    ~8k, and the first cell stalls on the full-kv DMA. Here kv arrives
+    one (BK, H) block per grid step, so Mosaic double-buffers the next
+    block's DMA behind the current block's compute, residency is O(BQ+BK)
+    (any sequence length), and the online-softmax state (acc, m, l) lives
+    in VMEM scratch carried across the ki sweep.
+
+    Causal skip: cells entirely above the diagonal run no compute
+    (pl.when) and fetch no data (their kv index map is clamped onto the
+    diagonal block, a repeat fetch Mosaic elides). The output is written
+    at the last ki step, which always runs.
+    """
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    q_start = qi * block_q
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    if causal:
+        last_kb = (q_start + block_q - 1) // block_k  # last contributing
+        run = ki <= last_kb
+        k_start = jnp.minimum(ki, last_kb) * block_k  # matches the clamp
+        # only the diagonal span needs element masking
+        is_diag = k_start + block_k > q_start
+    else:
+        run = True
+        k_start = ki * block_k
+        is_diag = False
+
+    def contribution(masked):
+        q = (q_ref[0, 0] * (scale * LOG2E)).astype(q_ref.dtype)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK), base-2 domain
+        if masked:
+            qpos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = m_new
+
+    if causal:
+        @pl.when(run & is_diag)
+        def _():
+            contribution(True)
+
+        @pl.when(run & jnp.logical_not(is_diag))
+        def _():
+            contribution(False)
+    else:
+        contribution(False)
+
+    @pl.when(ki == num_kb - 1)
+    def _():
+        l = l_ref[...]
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[...] * LN2 + jnp.log(l)
+
+
+def _flash_fwd_kvgrid(q, k, v, scale, causal, block_q, block_k, interpret):
+    """kv-streamed variant of _flash_fwd; same contract."""
+    batch, nq, seq_q, head = q.shape
+    nkv, seq_k = k.shape[1], k.shape[2]
+    group = nq // nkv
+    num_kb = seq_k // block_k
+
+    def kvmap(b, h, i, j):
+        if causal:
+            # clamp above-diagonal cells onto the diagonal block: no DMA
+            # is issued for skipped cells (repeat fetch), and in-bounds
+            # for every (i, j)
+            j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+        return (b, h // group, j, 0)
+
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel_kvgrid, scale=scale, causal=causal, num_kb=num_kb
+        ),
+        grid=(batch, nq, seq_q // block_q, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, head), kvmap),
+            pl.BlockSpec((1, 1, block_k, head), kvmap),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, head), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((batch, nq, seq_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head), jnp.float32),  # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max (base 2)
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running denominator
+        ],
+        # state carries across the ki sweep; outer three dims independent
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
     )(q, k, v)

@@ -93,6 +93,33 @@ def main():
         rows,
     )
 
+    # ---- ours, kv-streamed forward variant (FLASH_FWD_VARIANT=kvgrid):
+    # kv blocks walked by the grid with Mosaic double-buffering instead
+    # of staging the whole stream in VMEM; fwd-only (bwd is shared)
+    from fms_fsdp_tpu.ops.flash_attention import _flash_fwd_kvgrid
+
+    qb, kb, vb = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    kvgrid_fwd = jax.jit(
+        functools.partial(
+            _flash_fwd_kvgrid,
+            scale=H**-0.5,
+            causal=True,
+            block_q=512,
+            block_k=512,
+            interpret=False,
+        )
+    )
+    print("# benching kvgrid fwd variant", file=sys.stderr)
+    t = time_fn(kvgrid_fwd, qb, kb, vb)
+    rows.append(
+        {
+            "kernel": "fms_fsdp_tpu kvgrid fwd variant",
+            "pass": "fwd",
+            "ms": round(t * 1e3, 3),
+            "tf_s": round(FWD_FLOPS / t / 1e12, 1),
+        }
+    )
+
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
 
     # ---- jax bundled flash_attention (best blocks found by sweep: 512)

@@ -132,3 +132,28 @@ def test_dispatcher_fallback_small_heads():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
     with pytest.raises(NotImplementedError):
         attention(q, k, v, impl="pallas")
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("nq,nkv", [(4, 4), (4, 2)])
+def test_kvgrid_fwd_matches_resident(monkeypatch, causal, nq, nkv):
+    """The kv-streamed forward grid kernel is exactly the resident
+    kernel's math (same base-2 online softmax) — o and lse must agree to
+    float tolerance, including the causal skip/clamp cells and GQA
+    index maps, and at block_q != block_k."""
+    from fms_fsdp_tpu.ops import flash_attention as fa
+
+    q, k, v = _rand_qkv(2, 256, nq, nkv, 128, seed=3)
+    ref_o, ref_lse = flash_attention(
+        q, k, v, causal=causal, block_q=128, block_k=64, interpret=True,
+        return_lse=True,
+    )
+    monkeypatch.setenv("FLASH_FWD_VARIANT", "kvgrid")
+    out_o, out_lse = flash_attention(
+        q, k, v, causal=causal, block_q=128, block_k=64, interpret=True,
+        return_lse=True,
+    )
+    np.testing.assert_allclose(np.asarray(out_o), np.asarray(ref_o), atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(out_lse), np.asarray(ref_lse), atol=2e-5
+    )
