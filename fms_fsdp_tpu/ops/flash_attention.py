@@ -358,6 +358,117 @@ def _dq_kernel(
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
+def _dq_kernel_kvgrid(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, scale, causal, num_kb,
+):
+    """kv-streamed dq: grid (b, h, qi, ki), dq accumulated in VMEM scratch
+    across the ki sweep — the streamed counterpart of _dq_kernel, same
+    skip/clamp scheme as _fwd_kernel_kvgrid, O(block) VMEM residency."""
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    q_start = qi * block_q
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    if causal:
+        last_kb = (q_start + block_q - 1) // block_k
+        run = ki <= last_kb
+        k_start = jnp.minimum(ki, last_kb) * block_k  # matches the clamp
+        is_diag = k_start + block_k > q_start
+    else:
+        run = True
+        k_start = ki * block_k
+        is_diag = False
+
+    def contribution(masked):
+        q = (q_ref[0, 0] * (scale * LOG2E)).astype(q_ref.dtype)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse2 = lse_ref[0, 0] * LOG2E
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # base-2 domain
+        if masked:
+            qpos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp2(s - lse2)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    if causal:
+        @pl.when(run & is_diag)
+        def _():
+            contribution(True)
+
+        @pl.when(run & jnp.logical_not(is_diag))
+        def _():
+            contribution(False)
+    else:
+        contribution(False)
+
+    @pl.when(ki == num_kb - 1)
+    def _():
+        dq_ref[0, 0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_dq_kvgrid(
+    q, k, v, dout, lse, delta, *, scale, causal, block_q, block_k, interpret,
+    out_dtype=None,
+):
+    """kv-streamed variant of flash_dq; same contract."""
+    batch, nq, seq_q, head = q.shape
+    nkv, seq_k = k.shape[1], k.shape[2]
+    group = nq // nkv
+    num_kb = seq_k // block_k
+
+    def kvmap(b, h, i, j):
+        if causal:
+            j = jnp.minimum(j, (i * block_q + block_q - 1) // block_k)
+        return (b, h // group, j, 0)
+
+    def qmap(b, h, i, j):
+        return (b, h, i, 0)
+
+    return pl.pallas_call(
+        functools.partial(
+            _dq_kernel_kvgrid, scale=scale, causal=causal, num_kb=num_kb
+        ),
+        grid=(batch, nq, seq_q // block_q, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head), qmap),
+            pl.BlockSpec((1, 1, block_k, head), kvmap),
+            pl.BlockSpec((1, 1, block_k, head), kvmap),
+            pl.BlockSpec((1, 1, block_q, head), qmap),
+            pl.BlockSpec((1, 1, block_q, 1), qmap),
+            pl.BlockSpec((1, 1, block_q, 1), qmap),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, head), qmap),
+        out_shape=jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q, k, v, dout, lse, delta)
+
+
 # ---------------------------------------------------------------------------
 # backward: dk, dv
 # ---------------------------------------------------------------------------
@@ -481,7 +592,17 @@ def flash_dq(
     are the (global) softmax stats of the queries, (B, N, S, 1) fp32 —
     callable per ring step with stats from the full softmax. ``out_dtype``
     (default q.dtype) should be fp32 when partials are accumulated across
-    ring steps, so per-step rounding doesn't compound."""
+    ring steps, so per-step rounding doesn't compound.
+
+    FLASH_FWD_VARIANT=kvgrid selects the kv-streamed implementation
+    (O(block) VMEM residency, any sequence length) — one switch for the
+    forward and this kernel so the whole VJP shares a residency model."""
+    if os.environ.get("FLASH_FWD_VARIANT", "resident") == "kvgrid":
+        return _flash_dq_kvgrid(
+            q, k, v, dout, lse, delta, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            out_dtype=out_dtype,
+        )
     batch, nq, seq_q, head = q.shape
     nkv, seq_k = k.shape[1], k.shape[2]
     group = nq // nkv
@@ -665,10 +786,11 @@ def _pick_block(seq: int, target: int) -> int:
     return max(b, 1)
 
 
-# The kernels stage the full per-head sequence in VMEM (k+v forward; q+do
-# additionally in the dk/dv pass): ~8 * S * H bytes. Cap the sequence so
-# residency stays within the ~16MB/core budget; longer contexts use the
-# ring/context-parallel path or the XLA fallback.
+# The resident kernels stage the full per-head sequence in VMEM (k+v
+# forward and dq): ~8 * S * H bytes. Cap the sequence so residency stays
+# within the ~16MB/core budget; longer contexts use the kv-streamed
+# variant (FLASH_FWD_VARIANT=kvgrid — O(block) residency, no cap), the
+# ring/context-parallel path, or the XLA fallback.
 MAX_KERNEL_SEQ = 8192
 
 
@@ -676,12 +798,16 @@ def supports(q_shape, k_shape) -> bool:
     """Eligibility of the Pallas path for these shapes."""
     _, sq, nq, h = q_shape
     _, sk, nkv, _ = k_shape
+    if os.environ.get("FLASH_FWD_VARIANT", "resident") == "kvgrid":
+        max_seq = float("inf")  # every kernel is O(block)-resident
+    else:
+        max_seq = MAX_KERNEL_SEQ
     return (
         h % 128 == 0
         and sq % 256 == 0
         and sk % 256 == 0
-        and sq <= MAX_KERNEL_SEQ
-        and sk <= MAX_KERNEL_SEQ
+        and sq <= max_seq
+        and sk <= max_seq
         and nq % max(nkv, 1) == 0
     )
 

@@ -157,3 +157,23 @@ def test_kvgrid_fwd_matches_resident(monkeypatch, causal, nq, nkv):
     np.testing.assert_allclose(
         np.asarray(out_lse), np.asarray(ref_lse), atol=2e-5
     )
+
+
+def test_kvgrid_grads_match_resident(monkeypatch):
+    """With FLASH_FWD_VARIANT=kvgrid the full VJP (streamed fwd + streamed
+    dq + the shared dkv kernel) must produce the same gradients as the
+    resident kernels."""
+    q, k, v = _rand_qkv(1, 256, 4, 2, 128, seed=5)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, block_q=128, block_k=64, interpret=True
+            ).astype(jnp.float32)
+        )
+
+    ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setenv("FLASH_FWD_VARIANT", "kvgrid")
+    out = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(out, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
