@@ -251,12 +251,29 @@ ROWS = [
             },
         ),
     ),
+    # long context on ONE chip: 4x past the resident kernels' 8k VMEM cap
+    # via the kv-streamed flash variant (O(block) residency) + chunked
+    # fused CE so the (S, V) logits never materialize
+    (
+        "llama3_194m 16k-context bs=1 selAC=1/2 bf16 kvgrid-flash fusedCE",
+        dict(
+            variant="llama3_194m_4k",
+            batch_size=1,
+            sel_ac=0.5,
+            seq_length=16384,
+            fused_loss=True,
+            _env={"FLASH_FWD_VARIANT": "kvgrid"},
+        ),
+    ),
 ]
 
 
 def _child_row(idx):
     """Run one row in this process and print its JSON result (child mode)."""
     label, kw = ROWS[idx]
+    kw = dict(kw)
+    for name, value in kw.pop("_env", {}).items():
+        os.environ[name] = value  # row-scoped: each row is its own process
     try:
         r = run_config(**kw)
     except Exception as e:  # noqa: BLE001
