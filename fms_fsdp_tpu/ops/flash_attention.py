@@ -121,9 +121,9 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
     Two implementations (identical math/contract): the kv-resident
     fori_loop kernel below, and the kv-streamed grid kernel
-    (_fwd_kernel_kvgrid). FLASH_FWD_VARIANT=kvgrid selects the latter —
-    raced on chip by scripts/bench_kernels.py."""
-    if os.environ.get("FLASH_FWD_VARIANT", "resident") == "kvgrid":
+    (_fwd_kernel_kvgrid). FLASH_FWD_VARIANT overrides the automatic
+    choice — raced on chip by scripts/bench_kernels.py."""
+    if _use_kvgrid(k.shape[2]):
         return _flash_fwd_kvgrid(
             q, k, v, scale, causal, block_q, block_k, interpret
         )
@@ -594,10 +594,11 @@ def flash_dq(
     (default q.dtype) should be fp32 when partials are accumulated across
     ring steps, so per-step rounding doesn't compound.
 
-    FLASH_FWD_VARIANT=kvgrid selects the kv-streamed implementation
-    (O(block) VMEM residency, any sequence length) — one switch for the
-    forward and this kernel so the whole VJP shares a residency model."""
-    if os.environ.get("FLASH_FWD_VARIANT", "resident") == "kvgrid":
+    The kv-streamed implementation engages automatically past the
+    resident kernels' sequence cap (or via FLASH_FWD_VARIANT=kvgrid) —
+    one rule for the forward and this kernel so the whole VJP shares a
+    residency model."""
+    if _use_kvgrid(k.shape[2]):
         return _flash_dq_kvgrid(
             q, k, v, dout, lse, delta, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, interpret=interpret,
@@ -787,21 +788,30 @@ def _pick_block(seq: int, target: int) -> int:
 
 
 # The resident kernels stage the full per-head sequence in VMEM (k+v
-# forward and dq): ~8 * S * H bytes. Cap the sequence so residency stays
-# within the ~16MB/core budget; longer contexts use the kv-streamed
-# variant (FLASH_FWD_VARIANT=kvgrid — O(block) residency, no cap), the
-# ring/context-parallel path, or the XLA fallback.
+# forward and dq): ~8 * S * H bytes. Past this cap the dispatch switches
+# to the kv-streamed kernels (O(block) residency, any length), so the
+# Pallas path has no sequence limit; FLASH_FWD_VARIANT=resident|kvgrid
+# overrides the automatic choice (benching).
 MAX_KERNEL_SEQ = 8192
+
+
+def _use_kvgrid(seq_k: int) -> bool:
+    override = os.environ.get("FLASH_FWD_VARIANT")
+    if override == "kvgrid":
+        return True
+    if override == "resident":
+        return False
+    return seq_k > MAX_KERNEL_SEQ
 
 
 def supports(q_shape, k_shape) -> bool:
     """Eligibility of the Pallas path for these shapes."""
     _, sq, nq, h = q_shape
     _, sk, nkv, _ = k_shape
-    if os.environ.get("FLASH_FWD_VARIANT", "resident") == "kvgrid":
-        max_seq = float("inf")  # every kernel is O(block)-resident
+    if os.environ.get("FLASH_FWD_VARIANT") == "resident":
+        max_seq = MAX_KERNEL_SEQ  # resident forced: the cap is real
     else:
-        max_seq = MAX_KERNEL_SEQ
+        max_seq = float("inf")  # kv-streamed kernels engage past the cap
     return (
         h % 128 == 0
         and sq % 256 == 0

@@ -115,10 +115,14 @@ def test_block_size_rounding():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
-def test_supports_eligibility():
+def test_supports_eligibility(monkeypatch):
     assert supports((2, 4096, 32, 128), (2, 4096, 8, 128))
     assert not supports((2, 4096, 32, 64), (2, 4096, 8, 64))  # head dim
     assert not supports((2, 100, 4, 128), (2, 100, 4, 128))  # seq align
+    # past the resident cap: the kv-streamed kernels engage, no limit
+    assert supports((1, 32768, 8, 128), (1, 32768, 2, 128))
+    monkeypatch.setenv("FLASH_FWD_VARIANT", "resident")
+    assert not supports((1, 32768, 8, 128), (1, 32768, 2, 128))
 
 
 def test_dispatcher_fallback_small_heads():
@@ -177,3 +181,20 @@ def test_kvgrid_grads_match_resident(monkeypatch):
     out = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(out, ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_auto_kvgrid_dispatch_past_cap(monkeypatch):
+    """With the resident cap lowered, the dispatcher auto-selects the
+    kv-streamed kernels and still matches the resident result."""
+    from fms_fsdp_tpu.ops import flash_attention as fa
+
+    q, k, v = _rand_qkv(1, 256, 4, 2, 128, seed=7)
+    ref = flash_attention(
+        q, k, v, causal=True, block_q=128, block_k=128, interpret=True
+    )
+    monkeypatch.setattr(fa, "MAX_KERNEL_SEQ", 128)
+    assert fa._use_kvgrid(256)
+    out = flash_attention(
+        q, k, v, causal=True, block_q=128, block_k=128, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
