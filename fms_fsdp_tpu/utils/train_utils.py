@@ -15,6 +15,7 @@ cadence, resume semantics. TPU differences:
 """
 
 import os
+import signal
 import time
 from dataclasses import asdict
 
@@ -170,6 +171,40 @@ def _memory_stats():
     return stats.get("peak_bytes_in_use", 0), stats.get("bytes_in_use", 0)
 
 
+class PreemptionGuard:
+    """SIGTERM -> checkpoint at the next step boundary, then exit clean.
+
+    TPU capacity is commonly preemptible (spot/queued resources send
+    SIGTERM with a grace window before teardown); the reference's story
+    is restart-based resume from the last *interval* checkpoint, which
+    loses up to checkpoint_interval steps. The guard converts the grace
+    window into an up-to-date checkpoint.
+
+    Multi-host note: the Orbax save is collective, so the guard only
+    helps when every process receives the signal (the normal pod
+    preemption behavior). The flag is checked at the same step boundary
+    on all ranks; a rank that missed the signal would keep training and
+    desync the collective — hence saves trigger on the step AFTER the
+    signal, which every rank reaches before the grace window ends.
+    """
+
+    def __init__(self):
+        self.triggered = False
+        self._prev = None
+
+    def install(self):
+        def handler(signum, frame):
+            self.triggered = True
+            if self._prev not in (None, signal.SIG_DFL, signal.SIG_IGN):
+                self._prev(signum, frame)
+
+        try:
+            self._prev = signal.signal(signal.SIGTERM, handler)
+        except ValueError:
+            pass  # not the main thread (tests, embedded use): no-op
+        return self
+
+
 def train(
     cfg,
     state,
@@ -228,6 +263,7 @@ def _train_loop(
     start = time.time()
     loop_start = time.time()
     new_tokens_seen = 0
+    preemption = PreemptionGuard().install()
 
     for batch_idx, batch in enumerate(train_loader, start=start_step + 1):
         if batch_idx > cfg.num_steps:
@@ -306,12 +342,23 @@ def _train_loop(
                     )
             start = time.time()
 
-        if batch_idx % cfg.checkpoint_interval == 0 or batch_idx == cfg.num_steps:
+        if (
+            batch_idx % cfg.checkpoint_interval == 0
+            or batch_idx == cfg.num_steps
+            or preemption.triggered
+        ):
             checkpointer.save(
                 batch_idx,
                 state,
                 None,
                 tokens_seen=tokens_seen + new_tokens_seen,
             )
+        if preemption.triggered:
+            if rank == 0:
+                print(
+                    f"preemption signal received: checkpoint saved at step "
+                    f"{batch_idx}, exiting clean"
+                )
+            break
 
     return train_loss
