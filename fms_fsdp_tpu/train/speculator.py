@@ -247,11 +247,14 @@ def train_speculator(
         // max(1, getattr(cfg, "tensor_parallel_size", 1))
         // max(1, getattr(cfg, "context_parallel_size", 1)),
     )
+    from fms_fsdp_tpu.utils.train_utils import PreemptionGuard
+
     window = []
     elapsed_tokens = 0
     start = time.time()
     loop_start = time.time()
     step_tok = 0
+    preemption = PreemptionGuard().install()
 
     for batch_idx, inputs in enumerate(train_loader, start=start_step + 1):
         if batch_idx > cfg.num_steps:
@@ -314,6 +317,7 @@ def train_speculator(
             batch_idx % cfg.checkpoint_interval == 0
             or batch_idx == cfg.num_steps
             or do_ckpt(cfg.ckpt_save_path) is True
+            or preemption.triggered
         ):
             checkpointer.save(
                 batch_idx,
@@ -322,5 +326,12 @@ def train_speculator(
                 tokens_seen=elapsed_tokens + n_tok,
             )
             do_ckpt(cfg.ckpt_save_path, reset=True)
+        if preemption.triggered:
+            if rank == 0:
+                print(
+                    f"preemption signal received: checkpoint saved at step "
+                    f"{batch_idx}, exiting clean"
+                )
+            break
 
     return spec_state
