@@ -180,17 +180,25 @@ class PreemptionGuard:
     loses up to checkpoint_interval steps. The guard converts the grace
     window into an up-to-date checkpoint.
 
-    Multi-host note: the Orbax save is collective, so the guard only
-    helps when every process receives the signal (the normal pod
-    preemption behavior). The flag is checked at the same step boundary
-    on all ranks; a rank that missed the signal would keep training and
-    desync the collective — hence saves trigger on the step AFTER the
-    signal, which every rank reaches before the grace window ends.
+    Multi-host note: the Orbax save is collective, so every process must
+    enter it at the same step. ``poll()`` makes the trigger itself
+    collective: each boundary, every rank contributes its local flag to a
+    tiny jitted global max over all devices, and the boundary's decision
+    reads the collective result dispatched one boundary earlier — so a
+    rank that never received SIGTERM (delivery straddling a boundary, or
+    a scheduler that signals only one rank) still saves at the same step
+    as the rank that did. The one-boundary pipeline delay keeps the fetch
+    non-blocking in steady state (the collective finished during the
+    step) at the cost of saving one step after the signal — well inside
+    any real grace window. Single-process worlds skip the collective
+    entirely and see the flag at the boundary it arrived.
     """
 
     def __init__(self):
         self.triggered = False
         self._prev = None
+        self._dispatch = None
+        self._inflight = None
 
     def install(self):
         def handler(signum, frame):
@@ -203,6 +211,34 @@ class PreemptionGuard:
         except ValueError:
             pass  # not the main thread (tests, embedded use): no-op
         return self
+
+    def _make_dispatch(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(np.array(jax.devices()), ("all",))
+        sharding = NamedSharding(mesh, PartitionSpec("all"))
+        n_local = len(jax.local_devices())
+        _max = jax.jit(jnp.max)
+
+        def dispatch(flag: bool):
+            local = np.full((n_local,), 1 if flag else 0, dtype=np.int32)
+            garr = jax.make_array_from_process_local_data(sharding, local)
+            return _max(garr)
+
+        return dispatch
+
+    def poll(self) -> bool:
+        """Call exactly once per step boundary on every rank. Returns the
+        globally-agreed flag (identical on all ranks at the same step)."""
+        if jax.process_count() == 1:
+            return self.triggered
+        if self._dispatch is None:
+            self._dispatch = self._make_dispatch()
+        agreed = bool(self._inflight) if self._inflight is not None else False
+        self._inflight = self._dispatch(self.triggered)
+        return agreed
 
 
 def train(
@@ -342,10 +378,11 @@ def _train_loop(
                     )
             start = time.time()
 
+        preempt_now = preemption.poll()
         if (
             batch_idx % cfg.checkpoint_interval == 0
             or batch_idx == cfg.num_steps
-            or preemption.triggered
+            or preempt_now
         ):
             checkpointer.save(
                 batch_idx,
@@ -353,7 +390,7 @@ def _train_loop(
                 None,
                 tokens_seen=tokens_seen + new_tokens_seen,
             )
-        if preemption.triggered:
+        if preempt_now:
             if rank == 0:
                 print(
                     f"preemption signal received: checkpoint saved at step "

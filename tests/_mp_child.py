@@ -65,6 +65,43 @@ if __name__ == "__main__":
             attention_kernel="xla",
             **LLAMA_TINY,
         )
+    elif mode == "cp_pallas":
+        # ring attention cross-process WITH the Pallas flash partials in
+        # the loop (interpret mode on CPU): head_dim must be a
+        # 128-multiple and the per-device sequence 256-aligned for
+        # ring's _flash_eligible gate to pick the kernels — the
+        # kernel+collective composition a real pod runs (VERDICT r3 #7)
+        import main_training_llama as entry
+
+        kw.update(
+            sharding_strategy="fsdp",
+            context_parallel_size=2,
+            num_steps=4,
+            checkpoint_interval=4,
+            batch_size=1,
+            seq_length=512,
+            **{
+                "LlamaConfig.nlayers": 1,
+                "LlamaConfig.emb_dim": 512,
+                "LlamaConfig.nheads": 4,
+                "LlamaConfig.kvheads": 2,
+                "LlamaConfig.src_vocab_size": 256,
+                "LlamaConfig.multiple_of": 16,
+                "LlamaConfig.max_expected_seq_len": 512,
+            },
+        )
+    elif mode == "hsdp_tp":
+        # the 2-D HSDP mesh with the replica axis spanning the process
+        # boundary (the multi-slice DCN pattern: grad all-reduce across
+        # processes, param all-gather within) composed with a tensor
+        # axis — neither had executed cross-process before (dryrun only)
+        import main_training_llama as entry
+
+        kw.update(
+            sharding_strategy="hsdp",
+            tensor_parallel_size=2,
+            **LLAMA_TINY,
+        )
     elif mode == "ep":
         # MoE expert-parallel all-to-all crossing the process boundary
         import main_training_mixtral as entry
@@ -75,7 +112,30 @@ if __name__ == "__main__":
             attention_kernel="xla",
             **MIXTRAL_TINY,
         )
+    elif mode == "preempt":
+        # long run, interval saves unreachable: the only checkpoint can
+        # come from the collective preemption trigger (parent SIGTERMs
+        # exactly ONE rank; PreemptionGuard.poll must spread the flag)
+        import main_training_llama as entry
+
+        kw.update(
+            sharding_strategy="fsdp",
+            num_steps=500,
+            checkpoint_interval=400,
+            **LLAMA_TINY,
+        )
     else:
         raise SystemExit(f"unknown mode {mode!r}")
     entry.main(**kw)
+    if mode == "cp_pallas":
+        # the same predicate ring_attention evaluated at trace time must
+        # have selected the Pallas partials for these shapes — otherwise
+        # this mode silently degrades to the XLA partials cp covers.
+        # Checked AFTER main: _flash_eligible calls jax.default_backend(),
+        # which before setup()'s jax.config CPU redirect would initialize
+        # the real (possibly dead) TPU backend and hang the whole world.
+        from fms_fsdp_tpu.ops.ring_attention import _flash_eligible
+
+        assert _flash_eligible((1, 512, 4, 128), (1, 512, 2, 128), 2)
+        print("CP_PALLAS_ELIGIBLE", flush=True)
     print("MP_CHILD_DONE", flush=True)

@@ -27,12 +27,16 @@ def _free_port():
         return s.getsockname()[1]
 
 
-@pytest.mark.parametrize("mode", ["fsdp", "cp", "ep"])
+@pytest.mark.parametrize("mode", ["fsdp", "cp", "cp_pallas", "hsdp_tp", "ep"])
 def test_two_process_train(tmp_path, mode):
     # wall-clock bound: the communicate(timeout=840) below kills both
     # ranks on a hang (pytest-timeout isn't installed in this image).
     # Modes: fsdp = cross-process param all-gather/reduce-scatter;
     # cp = ring attention's ppermute across the process boundary;
+    # cp_pallas = same ring, with the Pallas flash partials (interpret
+    # mode) inside the cross-process ring — kernel+collective composition;
+    # hsdp_tp = 2-D HSDP with the replica (DCN-analog) axis crossing the
+    # process boundary, composed with a tensor axis;
     # ep = the MoE expert-parallel all-to-all across the process boundary.
     port = _free_port()
     ckpt = str(tmp_path / "ckpt")
@@ -71,6 +75,8 @@ def test_two_process_train(tmp_path, mode):
 
     # rank 0 reports metrics; both ranks must reach the end
     assert "MP_CHILD_DONE" in outs[0] and "MP_CHILD_DONE" in outs[1]
+    if mode == "cp_pallas":
+        assert "CP_PALLAS_ELIGIBLE" in outs[0], outs[0][-2000:]
     losses = [
         float(line.split("loss:")[1].strip().split()[0])
         for line in outs[0].splitlines()
@@ -80,5 +86,6 @@ def test_two_process_train(tmp_path, mode):
     assert losses[-1] < losses[0], losses  # training made progress
 
     # the final-step checkpoint committed across both processes
+    final = 4 if mode == "cp_pallas" else 6
     ckpts = os.listdir(os.path.join(ckpt, "checkpoints"))
-    assert any("step_6" in c for c in ckpts), ckpts
+    assert any(f"step_{final}" in c for c in ckpts), ckpts

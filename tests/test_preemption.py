@@ -54,6 +54,69 @@ def _launch(ckpt, log_path, extra=()):
     )
 
 
+def test_sigterm_one_rank_of_two_process_world(tmp_path):
+    """SIGTERM delivered to ONE rank of a real 2-process world must still
+    produce a committed collective checkpoint and a clean exit on BOTH
+    ranks — the PreemptionGuard.poll() collective-agreement path (a
+    process-local flag would desync the Orbax collective save)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ckpt = str(tmp_path / "ckpt")
+    child = os.path.join(REPO, "tests", "_mp_child.py")
+    procs, logs = [], []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            NUM_PROCESSES="2",
+            PROCESS_ID=str(pid),
+        )
+        log_path = str(tmp_path / f"rank{pid}.log")
+        logs.append(log_path)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-u", child, ckpt, "preempt"],
+                stdout=open(log_path, "w"),
+                stderr=subprocess.STDOUT,
+                env=env,
+                cwd=REPO,
+            )
+        )
+    try:
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            if os.path.exists(logs[0]) and "loss:" in open(logs[0]).read():
+                break
+            for pid, p in enumerate(procs):
+                if p.poll() is not None:
+                    raise AssertionError(
+                        f"rank {pid} exited early:\n"
+                        + open(logs[pid]).read()[-3000:]
+                    )
+            time.sleep(1)
+        else:
+            raise AssertionError("no training progress before deadline")
+        procs[0].send_signal(signal.SIGTERM)  # rank 0 ONLY
+        rcs = [p.wait(timeout=420) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    outs = [open(lp).read() for lp in logs]
+    for pid, rc in enumerate(rcs):
+        assert rc == 0, f"rank {pid}:\n" + outs[pid][-3000:]
+    assert "preemption signal received" in outs[0], outs[0][-3000:]
+
+    ckpts = os.listdir(os.path.join(ckpt, "checkpoints"))
+    assert len(ckpts) == 1, ckpts  # the collective preemption save
+    assert int(ckpts[0].split("_")[1]) < 400, ckpts
+
+
 def test_sigterm_checkpoints_and_resumes(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     log1 = str(tmp_path / "run1.log")
