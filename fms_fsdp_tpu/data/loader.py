@@ -10,21 +10,30 @@ rank = rank * num_workers + worker_id, ref:dataset_utils.py:108-119), with
 batches drawn round-robin across workers (torch IterableDataset semantics).
 
 With ``num_workers > 1`` each worker pipeline runs in its own thread
-feeding a bounded queue, and batches are popped round-robin — real host
-parallelism for the compute-bound tokenizing (ParquetHandler) path,
-since HF tokenizers' rust encode releases the GIL (the reference gets
-the same from torch DataLoader worker *processes*,
-ref:dataloader_utils.py:144-146). Round-robin popping preserves the
-exact single-threaded batch order, and loader checkpointing keeps the
-reference's worker semantics: CheckpointDataset auto-saves inside each
-worker at its own batch boundaries (which, as with torch's prefetching
-workers, may run slightly ahead of consumption).
+(``worker_mode="thread"``, default) or its own forked process
+(``worker_mode="process"``) feeding a bounded queue, with batches popped
+round-robin — real host parallelism for the compute-bound tokenizing
+(ParquetHandler) path. Threads rely on HF tokenizers' rust encode
+releasing the GIL; the process mode matches the reference's
+unconditional process-level parallelism (torch DataLoader worker
+processes, ref:dataloader_utils.py:144-146) and is immune to GIL
+contention from pure-Python pipeline stages. Round-robin popping
+preserves the exact single-threaded batch order, and loader
+checkpointing keeps the reference's worker semantics: CheckpointDataset
+auto-saves inside each worker at its own batch boundaries (which, as
+with torch's prefetching workers, may run ahead of consumption by up to
+``num_workers * (prefetch_batches + 1)`` batches; explicit state
+captures log the skew — see ``_log_skew``).
 Async device prefetch happens at the device-feed layer (device_feed.py),
 which is where TPU step-time overlap actually comes from.
 """
 
+import multiprocessing
+import pickle
 import queue
 import threading
+import time
+import traceback
 from copy import deepcopy
 from typing import Callable, List
 
@@ -68,6 +77,102 @@ def _stack(items):
     return np.stack(items)
 
 
+def _pickle_safe(e: BaseException) -> BaseException:
+    """An exception that survives the mp pickle boundary: the original if
+    it round-trips, else a RuntimeError carrying its formatted traceback."""
+    try:
+        pickle.loads(pickle.dumps(e))
+        return e
+    except Exception:
+        return RuntimeError(
+            "".join(traceback.format_exception(type(e), e, e.__traceback__))
+        )
+
+
+def _service_commands(pipeline, cmd) -> bool:
+    """Drain pending parent commands at a worker-process batch boundary.
+    Returns True on a stop command (the worker must exit). Every non-stop
+    command gets exactly one reply — a state-op failure replies with the
+    exception instead of leaving the parent blocked on recv()."""
+    while cmd.poll():
+        op, arg = cmd.recv()
+        if op == "stop":
+            return True
+        try:
+            if op == "state_dict":
+                reply = pipeline.state_dict()
+            elif op == "save_to_path":
+                pipeline.save_to_path(arg)
+                reply = "ok"
+            elif op == "load_state_dict":
+                pipeline.load_state_dict(*arg)
+                reply = "ok"
+            elif op == "load_from_path":
+                pipeline.load_from_path(arg)
+                reply = "ok"
+            else:
+                reply = RuntimeError(f"unknown loader command {op!r}")
+        except BaseException as e:  # noqa: BLE001 — forwarded to parent
+            reply = _pickle_safe(e)
+        cmd.send(reply)
+    return False
+
+
+def _process_worker_loop(pipeline, out_q, cmd, batch_size, produced):
+    """One worker pipeline in a forked process: produce stacked batches
+    into ``out_q``, service state commands from the parent at batch
+    boundaries (the process-mode analog of thread mode's per-worker
+    lock), and forward exceptions to the consumer. ``produced`` is a
+    shared counter of batches built, read by the parent for save-skew
+    accounting."""
+    import signal
+
+    try:
+        # the trainer's PreemptionGuard SIGTERM handler (which only sets
+        # a flag) is inherited across fork — restore the default so
+        # shutdown()'s terminate() actually terminates a stuck worker
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    except (ValueError, OSError):
+        pass
+    try:
+        pipeline.setup()
+        it = iter(pipeline)
+        while True:
+            if _service_commands(pipeline, cmd):
+                out_q.cancel_join_thread()
+                return
+            items = [next(it) for _ in range(batch_size)]
+            batch = _stack(items)
+            with produced.get_lock():
+                produced.value += 1
+            while True:
+                if _service_commands(pipeline, cmd):
+                    out_q.cancel_join_thread()
+                    return
+                try:
+                    out_q.put(batch, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+    except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+        payload = _pickle_safe(e)
+        sent = False
+        while True:  # keep servicing state commands until told to stop
+            try:
+                if _service_commands(pipeline, cmd):
+                    out_q.cancel_join_thread()
+                    return
+            except (EOFError, OSError, BrokenPipeError):
+                return  # parent is gone
+            if not sent:
+                try:
+                    out_q.put(payload, timeout=0.1)
+                    sent = True
+                except queue.Full:
+                    continue
+            time.sleep(0.05)
+
+
 class StatefulDataLoader:
     """Batching iterator over one or more pipeline clones ("workers").
 
@@ -83,11 +188,21 @@ class StatefulDataLoader:
         batch_size: int = 1,
         num_workers: int = 1,
         prefetch_batches: int = 2,
+        worker_mode: str = "thread",
     ):
+        assert worker_mode in ("thread", "process"), worker_mode
         self.batch_size = batch_size
         self.num_workers = max(1, num_workers)
         self.prefetch_batches = max(1, prefetch_batches)
+        self.worker_mode = worker_mode
         self._threads: List[threading.Thread] = []
+        self._procs: list = []
+        self._cmds: list = []
+        self._procs_started = False
+        # save-skew accounting: batches built per worker vs consumed by
+        # the trainer (explicit state captures log the difference)
+        self._produced: list = [[0] for _ in range(self.num_workers)]
+        self._consumed = [0] * self.num_workers
         # per-iterator-generation stop event: set-and-abandoned on
         # shutdown, REPLACED (never cleared) when a new iterator spawns
         # workers — a straggler thread that outlives a 5s join timeout
@@ -117,7 +232,7 @@ class StatefulDataLoader:
         return self.pipelines[0]
 
     @staticmethod
-    def _worker_loop(pipeline, out_q, lock, stop, batch_size):
+    def _worker_loop(pipeline, out_q, lock, stop, batch_size, produced):
         """Produce stacked batches from one worker pipeline into its queue.
         Exceptions are forwarded so the consumer re-raises them. The lock
         is held only while advancing the pipeline (never across the
@@ -132,6 +247,7 @@ class StatefulDataLoader:
             while not stop.is_set():
                 with lock:
                     items = [next(it) for _ in range(batch_size)]
+                    produced[0] += 1
                 batch = _stack(items)
                 while not stop.is_set():
                     try:
@@ -151,17 +267,56 @@ class StatefulDataLoader:
                     continue
 
     def shutdown(self):
-        """Stop worker threads (idempotent). Call before inspecting
-        pipeline state externally while an iterator is live."""
+        """Stop worker threads/processes (idempotent). Call before
+        inspecting pipeline state externally while an iterator is live."""
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5)
         self._threads = []
+        for c in self._cmds:
+            try:
+                c.send(("stop", None))
+            except (OSError, BrokenPipeError, ValueError):
+                pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=2)
+                if p.is_alive():
+                    p.kill()
+        self._procs, self._cmds = [], []
 
     def __del__(self):
         self._stop.set()  # reachable: worker threads don't reference self
+        for c in getattr(self, "_cmds", []):
+            try:
+                c.send(("stop", None))
+            except (OSError, BrokenPipeError, ValueError):
+                pass
+
+    def _workers_alive(self) -> bool:
+        return bool(self._procs) and any(p.is_alive() for p in self._procs)
+
+    def _log_skew(self, op: str):
+        """ADVICE r3: prefetching workers run ahead of consumption, so a
+        state capture includes up to num_workers*(prefetch_batches+1)
+        batches the trainer never saw — a resume skips them. Surface the
+        actual skew whenever state is captured from live workers."""
+        produced = [
+            p.value if hasattr(p, "value") else p[0] for p in self._produced
+        ]
+        skew = [p - c for p, c in zip(produced, self._consumed)]
+        if any(s > 0 for s in skew):
+            print(
+                f"loader {op}: worker prefetch ran {skew} batches ahead of "
+                f"consumption (per worker); resume will skip those batches"
+            )
 
     def __iter__(self):
+        if self.worker_mode == "process":
+            yield from self._iter_process()
+            return
         # Top-level setup propagates the (possibly worker-inflated)
         # rank/worldsize down the wrapper stack before any layer iterates.
         for p in self.pipelines:
@@ -173,16 +328,20 @@ class StatefulDataLoader:
 
         self.shutdown()
         self._stop = threading.Event()  # fresh generation (see __init__)
+        self._produced = [[0] for _ in range(self.num_workers)]
+        self._consumed = [0] * self.num_workers
         queues = [
             queue.Queue(maxsize=self.prefetch_batches) for _ in self.pipelines
         ]
         self._threads = [
             threading.Thread(
                 target=self._worker_loop,
-                args=(p, q, lk, self._stop, self.batch_size),
+                args=(p, q, lk, self._stop, self.batch_size, prod),
                 daemon=True,
             )
-            for p, q, lk in zip(self.pipelines, queues, self._locks)
+            for p, q, lk, prod in zip(
+                self.pipelines, queues, self._locks, self._produced
+            )
         ]
         for t in self._threads:
             t.start()
@@ -192,6 +351,73 @@ class StatefulDataLoader:
             if isinstance(batch, BaseException):
                 self.shutdown()
                 raise batch
+            self._consumed[w] += 1
+            yield batch
+            w = (w + 1) % self.num_workers
+
+    def _iter_process(self):
+        """Process-mode consumer: forked worker processes (the reference's
+        torch DataLoader worker-process model, ref:dataloader_utils.py:
+        144-146) feed bounded mp queues; state commands are serviced at
+        worker batch boundaries via per-worker pipes. Fork (not spawn)
+        so resumed/rescaled pipeline state built in the parent — e.g.
+        load_from_path before iteration — is inherited without pickling.
+
+        Fork caveat (same one torch DataLoader accepts with its fork
+        default): the parent is multithreaded by the time the loader
+        iterates (JAX dispatch/gRPC threads), and fork() snapshots mutex
+        state — a child could inherit a held allocator/gRPC lock and
+        deadlock. The workers never touch JAX (pure numpy/pyarrow/
+        tokenizers), which keeps the inherited-lock surface to the
+        allocator; if a worker ever hangs before producing its first
+        batch, the thread mode is the drop-in fallback."""
+        if self._procs_started:
+            raise RuntimeError(
+                "worker_mode='process': pipeline state lives in the worker "
+                "processes; re-iterating would silently restart the stream "
+                "from the parent's pre-fork state. Build a fresh loader "
+                "(resume via load_from_path) instead."
+            )
+        self.shutdown()
+        self._procs_started = True
+        ctx = multiprocessing.get_context("fork")
+        self._produced = [ctx.Value("q", 0) for _ in range(self.num_workers)]
+        self._consumed = [0] * self.num_workers
+        queues = [
+            ctx.Queue(maxsize=self.prefetch_batches) for _ in self.pipelines
+        ]
+        self._cmds = []
+        self._procs = []
+        for p, q, prod in zip(self.pipelines, queues, self._produced):
+            parent_conn, child_conn = ctx.Pipe()
+            proc = ctx.Process(
+                target=_process_worker_loop,
+                args=(p, q, child_conn, self.batch_size, prod),
+                daemon=True,
+            )
+            proc.start()
+            child_conn.close()
+            self._cmds.append(parent_conn)
+            self._procs.append(proc)
+        w = 0
+        while True:
+            while True:
+                try:
+                    batch = queues[w].get(timeout=1.0)
+                    break
+                except queue.Empty:
+                    if not self._procs or not self._procs[w].is_alive():
+                        exitcode = (
+                            self._procs[w].exitcode if self._procs else None
+                        )
+                        self.shutdown()
+                        raise RuntimeError(
+                            f"loader worker {w} died (exit {exitcode})"
+                        )
+            if isinstance(batch, BaseException):
+                self.shutdown()
+                raise batch
+            self._consumed[w] += 1
             yield batch
             w = (w + 1) % self.num_workers
 
@@ -209,21 +435,104 @@ class StatefulDataLoader:
             for lk in reversed(self.locks):
                 lk.release()
 
+    def _command_all(self, op: str, arg=None):
+        """Send a state command to every live worker process and collect
+        the replies (each worker answers at its next batch boundary — the
+        process-mode analog of grabbing all thread locks). A worker that
+        died or whose state op failed raises here instead of blocking the
+        trainer's checkpoint path forever — but only after EVERY live
+        worker's reply has been drained, so a partial failure can't leave
+        a stale reply queued in a pipe to be mis-attributed to the next
+        command."""
+        out, errs, sent = [], [], []
+        for c, p in zip(self._cmds, self._procs):
+            try:
+                c.send((op, arg))
+                sent.append(True)
+            except (OSError, BrokenPipeError, ValueError):
+                errs.append(
+                    RuntimeError(
+                        f"loader worker (pid {p.pid}) unreachable for "
+                        f"{op!r} (exit {p.exitcode})"
+                    )
+                )
+                sent.append(False)
+        for c, p, ok in zip(self._cmds, self._procs, sent):
+            if not ok:
+                out.append(None)
+                continue
+            reply = None
+            try:
+                while not c.poll(timeout=1.0):
+                    if not p.is_alive():
+                        raise RuntimeError(
+                            f"loader worker (pid {p.pid}) died during "
+                            f"{op!r} (exit {p.exitcode})"
+                        )
+                reply = c.recv()
+            except (RuntimeError, EOFError, OSError) as e:
+                errs.append(e)
+            if isinstance(reply, BaseException):
+                errs.append(reply)
+                reply = None
+            out.append(reply)
+        if errs:
+            raise errs[0]
+        return out
+
+    def _check_not_stale(self, op: str):
+        """worker_mode='process': all data-position state lives in the
+        forked workers — the parent's pipeline copies never advance.
+        Refuse to serve state from them once workers have run (a silent
+        batch-0 checkpoint would replay the whole consumed stream on
+        resume); capture state while workers are live instead (the
+        production paths do: CheckpointDataset auto-saves inside workers,
+        explicit saves go through the command channel)."""
+        if (
+            self.worker_mode == "process"
+            and self._procs_started
+            and not self._workers_alive()
+        ):
+            raise RuntimeError(
+                f"loader.{op} after process workers exited: their pipeline "
+                f"state is gone; capture state while workers are live"
+            )
+
     def state_dict(self) -> List[dict]:
+        self._check_not_stale("state_dict")
+        if self._workers_alive():
+            out = self._command_all("state_dict")
+            self._log_skew("state_dict")
+            return out
         with self._AllLocks(self._locks):
+            self._log_skew("state_dict")
             return [p.state_dict() for p in self.pipelines]
 
     def load_state_dict(self, state_dicts, sharded_input=False):
+        self._check_not_stale("load_state_dict")
+        if self._workers_alive():
+            self._command_all("load_state_dict", (state_dicts, sharded_input))
+            return
         with self._AllLocks(self._locks):
             for p in self.pipelines:
                 p.load_state_dict(state_dicts, sharded_input)
 
     def save_to_path(self, path: str):
+        self._check_not_stale("save_to_path")
+        if self._workers_alive():
+            self._command_all("save_to_path", path)
+            self._log_skew("save_to_path")
+            return
         with self._AllLocks(self._locks):
+            self._log_skew("save_to_path")
             for p in self.pipelines:
                 p.save_to_path(path)
 
     def load_from_path(self, path: str):
+        self._check_not_stale("load_from_path")
+        if self._workers_alive():
+            self._command_all("load_from_path", path)
+            return
         with self._AllLocks(self._locks):
             for p in self.pipelines:
                 p.load_from_path(path)
@@ -330,7 +639,10 @@ def get_data_loader(cfg, rank, world_size, postprocess=None):
         cfg.ckpt_save_path,
     )
     return StatefulDataLoader(
-        data, batch_size=cfg.batch_size, num_workers=cfg.num_workers
+        data,
+        batch_size=cfg.batch_size,
+        num_workers=cfg.num_workers,
+        worker_mode=getattr(cfg, "worker_mode", "thread"),
     )
 
 

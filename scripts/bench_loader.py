@@ -114,7 +114,7 @@ def build_parquet_dataset(root, n_files=4, docs_per_file=400, words_per_doc=700)
             f.write(f"{name},{d},{d * words_per_doc}\n")
 
 
-def run_mode(mode, num_workers, n_batches):
+def run_mode(mode, num_workers, n_batches, worker_mode="thread"):
     from fms_fsdp_tpu.config import TrainConfig
     from fms_fsdp_tpu.data import get_data_loader
 
@@ -150,6 +150,7 @@ def run_mode(mode, num_workers, n_batches):
         eos_token=0,
         logical_shards=64,
         num_workers=num_workers,
+        worker_mode=worker_mode,
         ckpt_load_path=os.path.join(root, "_no_ckpt"),
         resuming_dataset=False,
         **extra,
@@ -173,17 +174,25 @@ def main():
     demand_7b = 30_000 * 8
 
     rows = []
+    nw = int(os.environ.get("BENCH_WORKERS", "8"))
     plans = [
-        ("arrow", 1, 200),
-        ("parquet", 1, 40),
-        ("parquet", int(os.environ.get("BENCH_WORKERS", "8")), 40),
+        ("arrow", 1, 200, "thread"),
+        ("parquet", 1, 40, "thread"),
+        # worker scaling, both parallelism models: threads lean on the
+        # tokenizer's GIL-releasing rust encode; processes are the
+        # reference's torch-DataLoader model, immune to GIL contention
+        # in the pure-Python pipeline stages (needs a multi-CPU host to
+        # show scaling — 1-CPU hosts measure contention, NOTES.md r3)
+        ("parquet", nw, 40, "thread"),
+        ("parquet", nw, 40, "process"),
     ]
-    for mode, workers, n_batches in plans:
-        tok_s = run_mode(mode, workers, n_batches)
+    for mode, workers, n_batches, wmode in plans:
+        tok_s = run_mode(mode, workers, n_batches, wmode)
         rows.append(
             {
                 "pipeline": mode,
                 "num_workers": workers,
+                "worker_mode": wmode,
                 "tokens_per_sec": round(tok_s),
                 "vs_8chip_194m_demand": round(tok_s / demand_194m, 2),
                 "vs_8chip_7b_demand": round(tok_s / demand_7b, 2),

@@ -668,3 +668,64 @@ def test_multiprocess_epoch(datadir):
                     ins.append(int(out[0][0]))
             for i in range(100):
                 assert i * 100 in ins, (w, n, sorted(ins)[:10])
+
+
+def test_worker_mode_process_matches_thread(datadir):
+    """Forked worker processes emit the exact batch stream the threaded
+    workers do (round-robin order is part of the loader contract), so
+    worker_mode is a pure host-parallelism knob."""
+    bl, bs, bsc, bss = make_factories(datadir)
+
+    def build(mode):
+        d = bsc(0, 2, n_logical_shards=20)
+        d = BufferDataset(d, 110, False, pad_token=-1)
+        return StatefulDataLoader(
+            d, batch_size=2, num_workers=2, worker_mode=mode
+        )
+
+    thread_loader, proc_loader = build("thread"), build("process")
+    it_t, it_p = iter(thread_loader), iter(proc_loader)
+    try:
+        for _ in range(40):
+            assert np.array_equal(next(it_t), next(it_p))
+    finally:
+        thread_loader.shutdown()
+        proc_loader.shutdown()
+
+
+def test_worker_mode_process_live_state(datadir, tmp_path):
+    """State ops against live worker processes go through the per-worker
+    command channel at batch boundaries: state_dict returns one state per
+    inflated rank, save_to_path writes worker-owned shard files, and a
+    fresh loader resumes from them (rescale included: 2 workers -> 1)."""
+    bl, bs, bsc, bss = make_factories(datadir)
+    ckpdir = str(tmp_path / "proc_state")
+
+    d = bsc(0, 1, n_logical_shards=8)
+    d = BufferDataset(d, 110, False, pad_token=-1)
+    loader = StatefulDataLoader(d, batch_size=2, num_workers=2, worker_mode="process")
+    it = iter(loader)
+    for _ in range(10):
+        next(it)
+    states = loader.state_dict()
+    assert len(states) == 2 and all(isinstance(s, dict) for s in states)
+    loader.save_to_path(ckpdir)
+    next(it)  # workers still alive and producing after command servicing
+    loader.shutdown()
+    # state lived in the (now dead) workers: serving the parent's
+    # never-advanced copies would checkpoint batch-0 state — refuse
+    with pytest.raises(RuntimeError, match="workers exited"):
+        loader.state_dict()
+    with pytest.raises(RuntimeError, match="re-iterating"):
+        next(iter(loader))
+    import os
+
+    files = [f for f in os.listdir(ckpdir) if "loader_state" in f]
+    assert len(files) == 2, files
+
+    d2 = bsc(0, 1, n_logical_shards=8)
+    d2 = BufferDataset(d2, 110, False, pad_token=-1)
+    loader2 = StatefulDataLoader(d2, batch_size=2, num_workers=1)
+    loader2.load_from_path(ckpdir)
+    out = next(iter(loader2))
+    assert out.shape == (2, 110)
