@@ -49,6 +49,7 @@ def run_config(
     fused_loss=False,
     loss_chunk=4096,
     seq_length=4096,
+    flash_variant=None,
 ):
     import jax
 
@@ -90,6 +91,7 @@ def run_config(
         quantized_matmuls=quant,
         fused_loss=fused_loss,
         loss_chunk_size=loss_chunk,
+        flash_kernel_variant=flash_variant,
     )
     model_cfg = get_model_config(variant)
     if model_overrides:
@@ -262,7 +264,21 @@ ROWS = [
             sel_ac=0.5,
             seq_length=16384,
             fused_loss=True,
-            _env={"FLASH_FWD_VARIANT": "kvgrid"},
+            flash_variant="kvgrid",
+        ),
+    ),
+    # 8x past the resident cap on ONE chip — the public proof that the
+    # Pallas path has no sequence limit (full AC + fused CE keep the
+    # activations inside 16GB at 32k tokens)
+    (
+        "llama3_194m 32k-context bs=1 fullAC bf16 kvgrid-flash fusedCE",
+        dict(
+            variant="llama3_194m_4k",
+            batch_size=1,
+            sel_ac=1,
+            seq_length=32768,
+            fused_loss=True,
+            flash_variant="kvgrid",
         ),
     ),
 ]

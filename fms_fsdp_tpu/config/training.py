@@ -35,6 +35,10 @@ class TrainConfig:
     strip_tokens: str = ""
     logical_shards: int = 1024
     num_workers: int = 1
+    # "thread" workers rely on GIL-releasing rust tokenization; "process"
+    # forks workers (the reference's torch DataLoader model) for host
+    # parallelism immune to GIL contention in pure-Python pipeline stages
+    worker_mode: str = "thread"
 
     # sharding. ``sharding_strategy`` keeps the reference vocabulary
     # (ddp | fsdp | hsdp | tp, ref:fms_fsdp/config/training.py:31) but maps to
@@ -58,6 +62,11 @@ class TrainConfig:
     # TPU/XLA-specific compilation & kernel knobs
     scan_layers: bool = True  # lax.scan over the layer stack (fast compiles)
     attention_kernel: str = "auto"  # "auto" | "pallas" | "xla"
+    # flash kernel family: "resident" | "kvgrid" force one; "auto" forces
+    # by-sequence-length dispatch (resident under the 8k VMEM cap,
+    # kv-streamed past it); None = the import-time default
+    # (FLASH_KERNEL_VARIANT env, else auto). Resolved at every step build.
+    flash_kernel_variant: Optional[str] = None
     mamba_kernel: str = "auto"  # "auto" | "pallas" | "xla"
     # Chunked lm-head+CE (never materializes (B,S,V) logits). Costs one
     # extra lm-head pass (~+33% of lm-head FLOPs): a win for models where

@@ -57,6 +57,20 @@ except ImportError:
     HAS_PALLAS_FLASH = False
 
 
+def configure_flash_variant(variant) -> None:
+    """Apply TrainConfig.flash_kernel_variant before the step is traced
+    (a trace-time env read was the old mechanism — cached jits would keep
+    a stale variant, and the FWD-named env var silently governed the dq
+    backward kernel too; see ops/flash_attention.py::set_kernel_variant).
+
+    Applied unconditionally so every step build resolves the variant
+    from its own config: None restores the import-time default
+    (FLASH_KERNEL_VARIANT env, else auto) rather than inheriting a
+    forcing left by an earlier build in the same process."""
+    if HAS_PALLAS_FLASH:
+        _fa.set_kernel_variant(variant)
+
+
 def attention(q, k, v, *, causal: bool = True, impl: str = "auto"):
     """Dispatch: Pallas flash kernel on TPU for eligible shapes (head_dim a
     128-multiple, 256-aligned seq), XLA einsum otherwise."""

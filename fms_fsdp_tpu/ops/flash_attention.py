@@ -121,8 +121,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
     Two implementations (identical math/contract): the kv-resident
     fori_loop kernel below, and the kv-streamed grid kernel
-    (_fwd_kernel_kvgrid). FLASH_FWD_VARIANT overrides the automatic
-    choice — raced on chip by scripts/bench_kernels.py."""
+    (_fwd_kernel_kvgrid). FLASH_KERNEL_VARIANT / set_kernel_variant
+    overrides the automatic choice — raced on chip by scripts/bench_kernels.py."""
     if _use_kvgrid(k.shape[2]):
         return _flash_fwd_kvgrid(
             q, k, v, scale, causal, block_q, block_k, interpret
@@ -595,7 +595,7 @@ def flash_dq(
     ring steps, so per-step rounding doesn't compound.
 
     The kv-streamed implementation engages automatically past the
-    resident kernels' sequence cap (or via FLASH_FWD_VARIANT=kvgrid) —
+    resident kernels' sequence cap (or via FLASH_KERNEL_VARIANT=kvgrid) —
     one rule for the forward and this kernel so the whole VJP shares a
     residency model."""
     if _use_kvgrid(k.shape[2]):
@@ -790,16 +790,46 @@ def _pick_block(seq: int, target: int) -> int:
 # The resident kernels stage the full per-head sequence in VMEM (k+v
 # forward and dq): ~8 * S * H bytes. Past this cap the dispatch switches
 # to the kv-streamed kernels (O(block) residency, any length), so the
-# Pallas path has no sequence limit; FLASH_FWD_VARIANT=resident|kvgrid
-# overrides the automatic choice (benching).
+# Pallas path has no sequence limit.
 MAX_KERNEL_SEQ = 8192
+
+# Kernel-family override ("resident" | "kvgrid" | None = automatic by
+# sequence length). It governs the forward AND the dq backward kernel.
+# Read ONCE at import (canonical env var FLASH_KERNEL_VARIANT;
+# FLASH_FWD_VARIANT kept as a legacy alias): a trace-time env read would
+# let a mid-process change silently disagree with already-cached jits.
+_ENV_VARIANT = os.environ.get(
+    "FLASH_KERNEL_VARIANT", os.environ.get("FLASH_FWD_VARIANT")
+)
+if _ENV_VARIANT not in (None, "auto", "resident", "kvgrid"):
+    # fail loud: a typo'd env value silently falling back to automatic
+    # dispatch would mislabel every benchmark run under it
+    raise ValueError(
+        f"FLASH_KERNEL_VARIANT={_ENV_VARIANT!r}: expected "
+        f"'resident' | 'kvgrid' | 'auto'"
+    )
+_VARIANT = None if _ENV_VARIANT == "auto" else _ENV_VARIANT
+
+
+def set_kernel_variant(variant):
+    """Select the kernel family: "resident" | "kvgrid" force one, "auto"
+    forces the automatic by-sequence-length dispatch, None restores the
+    import-time default (the FLASH_KERNEL_VARIANT env value, else auto) —
+    so every step build resolves the variant deterministically from its
+    own config, never inheriting a forcing left by an earlier build. Call
+    before tracing: already-cached jits keep the variant they were traced
+    with. Config plumbing: TrainConfig.flash_kernel_variant."""
+    global _VARIANT
+    assert variant in (None, "auto", "resident", "kvgrid"), variant
+    if variant is None:
+        variant = _ENV_VARIANT
+    _VARIANT = None if variant == "auto" else variant
 
 
 def _use_kvgrid(seq_k: int) -> bool:
-    override = os.environ.get("FLASH_FWD_VARIANT")
-    if override == "kvgrid":
+    if _VARIANT == "kvgrid":
         return True
-    if override == "resident":
+    if _VARIANT == "resident":
         return False
     return seq_k > MAX_KERNEL_SEQ
 
@@ -808,7 +838,7 @@ def supports(q_shape, k_shape) -> bool:
     """Eligibility of the Pallas path for these shapes."""
     _, sq, nq, h = q_shape
     _, sk, nkv, _ = k_shape
-    if os.environ.get("FLASH_FWD_VARIANT") == "resident":
+    if _VARIANT == "resident":
         max_seq = MAX_KERNEL_SEQ  # resident forced: the cap is real
     else:
         max_seq = float("inf")  # kv-streamed kernels engage past the cap

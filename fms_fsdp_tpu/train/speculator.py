@@ -85,6 +85,9 @@ def make_stage1_step(
     feed: embeds over input[:, :-n-1], head i scored against
     input[:, i+2 : N+i+2] (ref:train_speculator_utils.py:122-171)."""
     base_api = base_api or get_base_api("embedllama")
+    from fms_fsdp_tpu.ops.attention import configure_flash_variant
+
+    configure_flash_variant(getattr(cfg, "flash_kernel_variant", None))
     n_predict = scfg.n_predict
     schedule = get_speculator_lr_schedule(cfg)
     # int8 base forward: the frozen teacher's GEMMs can run on the MXU
@@ -313,11 +316,12 @@ def train_speculator(
                 print()
             start = time.time()
 
+        preempt_now = preemption.poll()
         if (
             batch_idx % cfg.checkpoint_interval == 0
             or batch_idx == cfg.num_steps
             or do_ckpt(cfg.ckpt_save_path) is True
-            or preemption.triggered
+            or preempt_now
         ):
             checkpointer.save(
                 batch_idx,
@@ -326,7 +330,7 @@ def train_speculator(
                 tokens_seen=elapsed_tokens + n_tok,
             )
             do_ckpt(cfg.ckpt_save_path, reset=True)
-        if preemption.triggered:
+        if preempt_now:
             if rank == 0:
                 print(
                     f"preemption signal received: checkpoint saved at step "

@@ -184,6 +184,9 @@ def make_train_step(
     pass 0.
     """
     policy = get_dtype_policy(cfg)
+    from fms_fsdp_tpu.ops.attention import configure_flash_variant
+
+    configure_flash_variant(getattr(cfg, "flash_kernel_variant", None))
     _, forward_fn, _, n_layers = get_model_api(model_cfg)
     ac_mask = None
     if cfg.fsdp_activation_checkpointing:

@@ -93,7 +93,7 @@ def main():
         rows,
     )
 
-    # ---- ours, kv-streamed forward variant (FLASH_FWD_VARIANT=kvgrid):
+    # ---- ours, kv-streamed forward variant (flash_kernel_variant="kvgrid"):
     # kv blocks walked by the grid with Mosaic double-buffering instead
     # of staging the whole stream in VMEM; fwd-only (bwd is shared)
     from fms_fsdp_tpu.ops.flash_attention import _flash_fwd_kvgrid

@@ -30,6 +30,57 @@ def test_flash_matches_xla(nq, nkv, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("variant", ["resident", "kvgrid"])
+def test_flash_bf16_parity(monkeypatch, variant):
+    """Production dtype parity (ADVICE r3): the base-2 rewrite folds
+    scale*log2(e) into q and casts back to bf16 before the MXU — one
+    extra bf16 rounding of q vs a fp32 post-matmul scale. Both kernel
+    families must track the fp32-softmax XLA oracle on bf16 inputs, for
+    the output AND the gradients, at bf16-appropriate tolerance."""
+    from fms_fsdp_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "_VARIANT", variant)
+    q, k, v = _rand_qkv(2, 256, 4, 2, 128, seed=11)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref = xla_attention(qb, kb, vb, causal=True)
+    out = flash_attention(
+        qb, kb, vb, causal=True, block_q=128, block_k=64, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32),
+        np.asarray(ref, np.float32),
+        atol=2e-2,
+        rtol=2e-2,
+    )
+
+    def mk_loss(fn):
+        def loss(q, k, v):
+            o = fn(q, k, v)
+            return jnp.sum(o.astype(jnp.float32) * (o.shape[-1] ** -0.5))
+
+        return loss
+
+    ref_g = jax.grad(
+        mk_loss(lambda q, k, v: xla_attention(q, k, v, causal=True)),
+        argnums=(0, 1, 2),
+    )(qb, kb, vb)
+    out_g = jax.grad(
+        mk_loss(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=128, block_k=64, interpret=True
+            )
+        ),
+        argnums=(0, 1, 2),
+    )(qb, kb, vb)
+    for a, b in zip(out_g, ref_g):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32),
+            np.asarray(b, np.float32),
+            atol=4e-2,
+            rtol=4e-2,
+        )
+
+
 def test_flash_return_lse_differentiable():
     """flash_attention(return_lse=True): both outputs carry gradients —
     the lse cotangent folds into the backward's delta (delta - dlse)."""
@@ -121,7 +172,9 @@ def test_supports_eligibility(monkeypatch):
     assert not supports((2, 100, 4, 128), (2, 100, 4, 128))  # seq align
     # past the resident cap: the kv-streamed kernels engage, no limit
     assert supports((1, 32768, 8, 128), (1, 32768, 2, 128))
-    monkeypatch.setenv("FLASH_FWD_VARIANT", "resident")
+    from fms_fsdp_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "_VARIANT", "resident")
     assert not supports((1, 32768, 8, 128), (1, 32768, 2, 128))
 
 
@@ -152,7 +205,7 @@ def test_kvgrid_fwd_matches_resident(monkeypatch, causal, nq, nkv):
         q, k, v, causal=causal, block_q=128, block_k=64, interpret=True,
         return_lse=True,
     )
-    monkeypatch.setenv("FLASH_FWD_VARIANT", "kvgrid")
+    monkeypatch.setattr(fa, "_VARIANT", "kvgrid")
     out_o, out_lse = flash_attention(
         q, k, v, causal=causal, block_q=128, block_k=64, interpret=True,
         return_lse=True,
@@ -164,9 +217,11 @@ def test_kvgrid_fwd_matches_resident(monkeypatch, causal, nq, nkv):
 
 
 def test_kvgrid_grads_match_resident(monkeypatch):
-    """With FLASH_FWD_VARIANT=kvgrid the full VJP (streamed fwd + streamed
-    dq + the shared dkv kernel) must produce the same gradients as the
-    resident kernels."""
+    """With the kvgrid variant selected the full VJP (streamed fwd +
+    streamed dq + the shared dkv kernel) must produce the same gradients
+    as the resident kernels."""
+    from fms_fsdp_tpu.ops import flash_attention as fa
+
     q, k, v = _rand_qkv(1, 256, 4, 2, 128, seed=5)
 
     def loss(q, k, v):
@@ -177,7 +232,7 @@ def test_kvgrid_grads_match_resident(monkeypatch):
         )
 
     ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-    monkeypatch.setenv("FLASH_FWD_VARIANT", "kvgrid")
+    monkeypatch.setattr(fa, "_VARIANT", "kvgrid")
     out = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(out, ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
