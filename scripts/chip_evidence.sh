@@ -29,6 +29,9 @@ timeout 2400 python scripts/bench_kernels.py || log "bench_kernels failed"
 log "3/4 bench_ssd.py"
 timeout 2400 python scripts/bench_ssd.py || log "bench_ssd failed"
 
+log "3b/4 profile_mamba.py (component attribution for the mamba MFU)"
+timeout 2400 python scripts/profile_mamba.py > /dev/null || log "profile_mamba failed"
+
 log "4/4 eval: train llama3_194m on the learnable dummy stream, then eval_ppl"
 rm -rf /tmp/eval_ckpt
 timeout 2400 python -u main_training_llama.py --use_dummy_dataset=True \
@@ -70,6 +73,6 @@ else:
 EOF
 
 log "done; captured:"
-for f in CHIP_BENCH.json BENCH_KERNELS.json BENCH_SSD.json EVAL.json; do
+for f in CHIP_BENCH.json BENCH_KERNELS.json BENCH_SSD.json PROFILE_MAMBA.json EVAL.json; do
     [ -f "$f" ] && echo "  $f: $(head -c 120 "$f")"
 done
