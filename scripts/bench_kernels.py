@@ -120,6 +120,53 @@ def main():
         }
     )
 
+    # ---- block-size sweep (fwd, both families): the race that picks the
+    # shipped defaults (VERDICT r3 item 2). 512/512 is omitted — the
+    # headline rows above already time both families there at higher
+    # iters. Skippable: BENCH_NO_SWEEP=1.
+    if not os.environ.get("BENCH_NO_SWEEP"):
+        from fms_fsdp_tpu.ops import flash_attention as fa
+
+        for bq, bk in [
+            (256, 256), (256, 512), (512, 256),
+            (512, 1024), (1024, 512), (1024, 1024),
+        ]:
+            for fam, fn in (
+                ("resident", fa._flash_fwd),
+                ("kvgrid", _flash_fwd_kvgrid),
+            ):
+                # pin the family: _flash_fwd dispatches through
+                # _use_kvgrid, so an ambient kvgrid override would make
+                # the "resident" rows silently measure the kvgrid kernel
+                fa.set_kernel_variant(fam)
+                f = jax.jit(
+                    functools.partial(
+                        fn, scale=H**-0.5, causal=True,
+                        block_q=bq, block_k=bk, interpret=False,
+                    )
+                )
+                print(f"# sweep {fam} bq={bq} bk={bk}", file=sys.stderr)
+                try:
+                    t = time_fn(f, qb, kb, vb, iters=30)
+                except Exception as e:  # noqa: BLE001 — record, keep sweeping
+                    rows.append(
+                        {
+                            "kernel": f"{fam} fwd bq={bq} bk={bk}",
+                            "pass": "fwd",
+                            "error": f"{type(e).__name__}: {e}"[:160],
+                        }
+                    )
+                    continue
+                rows.append(
+                    {
+                        "kernel": f"{fam} fwd bq={bq} bk={bk}",
+                        "pass": "fwd",
+                        "ms": round(t * 1e3, 3),
+                        "tf_s": round(FWD_FLOPS / t / 1e12, 1),
+                    }
+                )
+        fa.set_kernel_variant(None)  # restore import-time default
+
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
 
     # ---- jax bundled flash_attention (best blocks found by sweep: 512)
