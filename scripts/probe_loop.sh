@@ -12,6 +12,10 @@ while true; do
     echo "$ts launching chip_evidence.sh" >> "$LOG"
     bash scripts/chip_evidence.sh >> chip_evidence_run.log 2>&1
     echo "$(date -u +"%Y-%m-%dT%H:%M:%SZ") chip_evidence.sh finished rc=$?" >> "$LOG"
+    python scripts/summarize_chip_evidence.py >> chip_evidence_run.log 2>&1 || true
+    git add -A CHIP_BENCH.json BENCH_KERNELS.json BENCH_SSD.json \
+        PROFILE_MAMBA.json EVAL.json DECISIONS_r04.md PROBELOG.txt 2>/dev/null
+    git commit -q -m "Record chip evidence captured by the unattended probe loop" || true
     break
   else
     rc=$?
