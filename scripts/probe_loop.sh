@@ -13,9 +13,17 @@ while true; do
     bash scripts/chip_evidence.sh >> chip_evidence_run.log 2>&1
     echo "$(date -u +"%Y-%m-%dT%H:%M:%SZ") chip_evidence.sh finished rc=$?" >> "$LOG"
     python scripts/summarize_chip_evidence.py >> chip_evidence_run.log 2>&1 || true
-    git add -A CHIP_BENCH.json BENCH_KERNELS.json BENCH_SSD.json \
-        PROFILE_MAMBA.json EVAL.json DECISIONS_r04.md PROBELOG.txt 2>/dev/null
-    git commit -q -m "Record chip evidence captured by the unattended probe loop" || true
+    # add each artifact individually (several are optional — a single
+    # missing pathspec would abort the whole add), and commit only the
+    # evidence paths so operator-staged WIP is never swept in
+    evidence=""
+    for f in CHIP_BENCH.json BENCH_KERNELS.json BENCH_SSD.json \
+             PROFILE_MAMBA.json EVAL.json DECISIONS_r04.md PROBELOG.txt; do
+      [ -e "$f" ] && git add "$f" && evidence="$evidence $f"
+    done
+    [ -n "$evidence" ] && git commit -q \
+      -m "Record chip evidence captured by the unattended probe loop" \
+      -- $evidence || true
     break
   else
     rc=$?

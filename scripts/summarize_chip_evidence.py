@@ -17,17 +17,25 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def load(name):
-    try:
-        with open(os.path.join(ROOT, name)) as f:
-            return json.load(f)
-    except Exception:
+    path = os.path.join(ROOT, name)
+    if not os.path.exists(path):
         return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception as e:  # corrupt is different news than missing
+        print(f"WARNING: {name} exists but failed to parse: {e}", file=sys.stderr)
+        return {"_parse_error": f"{name}: {e}"}
 
 
 def main():
     lines = ["# Chip-evidence decision summary (auto-generated)", ""]
 
     bench = load("CHIP_BENCH.json")
+    if isinstance(bench, dict) and "_parse_error" in bench:
+        lines.append(f"## Headline: CORRUPT artifact — {bench['_parse_error']}")
+        lines.append("")
+        bench = None
     if bench and bench.get("rows"):
         v = bench.get("vs_baseline")
         lines.append(
@@ -46,7 +54,7 @@ def main():
 
     kernels = load("BENCH_KERNELS.json")
     if kernels:
-        rows = kernels.get("rows", kernels if isinstance(kernels, list) else [])
+        rows = kernels if isinstance(kernels, list) else kernels.get("rows", [])
         fwd = [
             r
             for r in rows
@@ -79,7 +87,7 @@ def main():
 
     ssd = load("BENCH_SSD.json")
     if ssd:
-        rows = ssd.get("rows", ssd if isinstance(ssd, list) else [])
+        rows = ssd if isinstance(ssd, list) else ssd.get("rows", [])
         try:
             tbl = {
                 r.get("kernel", r.get("name", "?")): r

@@ -729,3 +729,24 @@ def test_worker_mode_process_live_state(datadir, tmp_path):
     loader2.load_from_path(ckpdir)
     out = next(iter(loader2))
     assert out.shape == (2, 110)
+
+
+def test_worker_mode_process_failed_command_keeps_channel_usable(datadir):
+    """A failed state op in one worker raises in the parent AFTER all
+    replies are drained, so the command channel stays in sync: the next
+    state op still returns real per-worker states (not a stale reply
+    mis-attributed from the failed round)."""
+    bl, bs, bsc, bss = make_factories(datadir)
+    d = bsc(0, 1, n_logical_shards=8)
+    d = BufferDataset(d, 110, False, pad_token=-1)
+    loader = StatefulDataLoader(d, batch_size=2, num_workers=2, worker_mode="process")
+    it = iter(loader)
+    for _ in range(4):
+        next(it)
+    # /proc/1/nonexistent is unwritable in every environment this runs in
+    with pytest.raises(OSError):
+        loader.save_to_path("/proc/1/nonexistent/ckpt")
+    states = loader.state_dict()  # channel must still be aligned
+    assert len(states) == 2 and all(isinstance(s, dict) for s in states)
+    next(it)  # and workers keep producing
+    loader.shutdown()
