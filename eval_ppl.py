@@ -108,7 +108,9 @@ def main(**kwargs):
     data_extent = data_parallel_extent(mesh)
     local_batch = cfg.batch_size * max(1, data_extent // world_size)
     if not cfg.use_dummy_dataset:
-        loader = get_data_loader(cfg, rank, world_size)
+        loader = get_data_loader(
+            cfg, rank, world_size, batch_multiplier=max(1, data_extent // world_size)
+        )
     else:
         loader = get_dummy_loader(cfg, rank, world_size)
 

@@ -10,7 +10,7 @@ from typing import Any, Callable, List
 import numpy as np
 
 from fms_fsdp_tpu.data.stateful import StatefulDataset, WrapperDataset
-from fms_fsdp_tpu.utils.ckpt_paths import get_latest
+from fms_fsdp_tpu.utils.ckpt_paths import get_latest, is_step_ckp, step_number
 
 _EMPTY = np.empty(0, dtype=np.int64)
 
@@ -225,7 +225,12 @@ class CheckpointDataset(WrapperDataset):
             print(msg)
 
     def _validate_ckp_path(self, path: str, verbose: bool = False):
-        """Resolve path to the newest complete checkpoint dir, or ''."""
+        """Resolve path to the newest checkpoint dir CONTAINING loader
+        state, or ''. Scans step dirs newest-first rather than inspecting
+        only the single newest: the checkpoints folder interleaves model
+        checkpoints (Checkpointer.save) with loader auto-saves, and when
+        their step numbering drifts (see get_data_loader's
+        batch_multiplier note) the newest dir may be model-only."""
         if not os.path.exists(path) or len(os.listdir(path)) == 0:
             if verbose:
                 self.report(
@@ -233,27 +238,29 @@ class CheckpointDataset(WrapperDataset):
                     "dataset starting from scratch."
                 )
             return ""
-        latest = get_latest(path, key=lambda p: int(p.split("_")[-2]))
+        candidates = sorted(
+            (
+                os.path.join(path, x)
+                for x in os.listdir(path)
+                if is_step_ckp(x)
+            ),
+            key=step_number,
+            reverse=True,
+        )
+        for cand in candidates:
+            if os.path.isdir(cand) and any(
+                "loader" in x for x in os.listdir(cand)
+            ):
+                if verbose:
+                    self.report(f"Checkpoint detected at {cand}")
+                self.step = step_number(cand)
+                return cand
         if verbose:
-            self.report(f"Checkpoint detected at {latest}")
-        if os.path.isfile(latest):
-            if verbose:
-                self.report(
-                    f"  Dataset: Detected checkpoint {latest} is a single"
-                    " file with no dataset info. Dataset starting from"
-                    " scratch."
-                )
-            return ""
-        if len([x for x in os.listdir(latest) if "loader" in x]) == 0:
-            if verbose:
-                self.report(
-                    f"  Dataset: Detected checkpoint {latest} exists but"
-                    " contains no dataset checkpoints. Dataset starting"
-                    " from scratch."
-                )
-            return ""
-        self.step = int(latest.split("_")[-2])
-        return latest
+            self.report(
+                f"  Dataset: Checkpoints exist under {path} but none "
+                "contain dataset state. Dataset starting from scratch."
+            )
+        return ""
 
     def save_to_path(self, path: str):
         self.report(f"Saving dataset to {path}")

@@ -571,12 +571,27 @@ def get_dummy_loader(cfg, rank, world_size):
     return _SimpleLoader(SteadyCounter(cfg.seq_length, cfg.vocab_size), cfg.batch_size)
 
 
-def get_data_loader(cfg, rank, world_size, postprocess=None):
+def get_data_loader(cfg, rank, world_size, postprocess=None, batch_multiplier=1):
     """Build the full 7-layer pipeline
     (ref:dataloader_utils.py:60-146): streaming docs -> logical-shard
     rescaling -> weighted multi-dataset sampling -> fixed-length packing ->
     reservoir shuffle -> tensorize -> task postprocess -> auto-checkpoint,
     wrapped in the batching loader.
+
+    ``batch_multiplier``: loader batches consumed per trainer step by this
+    process (the ``rebatch`` factor — data-parallel shards per process).
+    It keeps CheckpointDataset's auto-save step numbering aligned with
+    trainer steps, preserving the reference invariant that loader state
+    lands in the same ``step_N_ckp`` dirs as model checkpoints
+    (ref:dataloader_utils.py:137-143 counts its interval in trainer
+    batches; one torch batch = one trainer step there, but here one
+    trainer step consumes batch_multiplier loader batches spread
+    round-robin over num_workers workers). When num_workers does not
+    divide the per-step row count the worker step clock diverges from the
+    trainer's (by up to num_workers/rows_per_step when workers outnumber
+    per-step rows) — a warning is printed, and resume still works because
+    both checkpoint validators scan for the newest directory of their own
+    kind.
     """
     if postprocess is None:
         postprocess = [causal_lm]
@@ -631,11 +646,24 @@ def get_data_loader(cfg, rank, world_size, postprocess=None):
     for p in postprocess:
         data = PreprocessDataset(data, p)
 
+    # rows one worker emits per trainer step (see batch_multiplier above)
+    rows_per_step = cfg.batch_size * max(1, batch_multiplier)
+    steps_per_batch = max(1, rows_per_step // max(1, cfg.num_workers))
+    if rank == 0 and rows_per_step % max(1, cfg.num_workers) != 0:
+        # worst case (num_workers > rows_per_step) the worker step clock
+        # runs num_workers/rows_per_step times SLOW, not "slightly off"
+        print(
+            f"WARNING: num_workers={cfg.num_workers} does not divide the "
+            f"per-step row count {rows_per_step}; loader auto-save step "
+            f"numbering will drift from trainer steps (resume still works "
+            f"— both checkpoint scanners pick the newest dir of their own "
+            f"kind — but on-disk step numbers won't correlate)"
+        )
     data = CheckpointDataset(
         data,
         cfg.ckpt_load_path if cfg.resuming_dataset else cfg.ckpt_save_path,
         cfg.checkpoint_interval,
-        cfg.batch_size,
+        steps_per_batch,
         cfg.ckpt_save_path,
     )
     return StatefulDataLoader(

@@ -155,14 +155,24 @@ class Checkpointer:
         if len(entries) > 0:
             # only step_<N>_ckp entries qualify (by step number, not
             # ctime): foreign files parked in the folder must not shadow
-            # real checkpoints
-            latest = get_latest(path, qualifier=is_step_ckp, key=step_number)
-            if latest is None:
-                return None
-            if os.path.isfile(latest):
-                return latest
-            if "metadata.json" in os.listdir(latest):
-                return latest
+            # real checkpoints. Scan newest-first for a dir that actually
+            # holds MODEL state — the folder interleaves loader auto-save
+            # dirs (loader_state only, no metadata.json) with model
+            # checkpoints, and the newest step dir may be loader-only.
+            candidates = sorted(
+                (
+                    os.path.join(path, x)
+                    for x in entries
+                    if is_step_ckp(os.path.join(path, x))
+                ),
+                key=step_number,
+                reverse=True,
+            )
+            for cand in candidates:
+                if os.path.isfile(cand):
+                    return cand
+                if "metadata.json" in os.listdir(cand):
+                    return cand
         return None
 
     # -- cleanup ------------------------------------------------------------
@@ -176,15 +186,30 @@ class Checkpointer:
         the names ``save`` actually writes (step_<N>_ckp)."""
         if self.rank != 0:
             return None
+
+        def is_model_ckp(p):
+            return is_step_ckp(p) and (
+                os.path.isfile(p) or "metadata.json" in os.listdir(p)
+            )
+
+        # the quota counts MODEL checkpoints only: loader auto-save dirs
+        # (loader_state files, no metadata.json) share the folder and
+        # must not evict real checkpoints from the retention window
         while (
-            len([x for x in os.listdir(self.ckp_path) if is_step_ckp(x)])
+            len(
+                [
+                    x
+                    for x in os.listdir(self.ckp_path)
+                    if is_model_ckp(os.path.join(self.ckp_path, x))
+                ]
+            )
             > self.max_ckps
         ):
             # order by the step number in the name, not ctime: copied or
             # restored checkpoint trees don't preserve ctime, and deleting
             # by ctime could claim the newest step instead of the oldest
             oldest = get_oldest(
-                self.ckp_path, qualifier=is_step_ckp, key=step_number
+                self.ckp_path, qualifier=is_model_ckp, key=step_number
             )
             if oldest is None:
                 break
@@ -193,6 +218,25 @@ class Checkpointer:
                 ckp_to_remove.unlink()
             else:
                 shutil.rmtree(ckp_to_remove)
+        # loader-only auto-save dirs: CheckpointDataset resumes from the
+        # newest of them only, so keep the newest two (margin for a
+        # partially-written newest) and drop the rest. Ranked strictly
+        # among loader-only dirs — their step numbers are on the worker
+        # clock, which can lag or lead the trainer clock, so comparing
+        # them against model-checkpoint numbers would be meaningless (and
+        # at worst delete the only loader state).
+        loader_only = sorted(
+            (
+                os.path.join(self.ckp_path, x)
+                for x in os.listdir(self.ckp_path)
+                if is_step_ckp(x)
+                and not is_model_ckp(os.path.join(self.ckp_path, x))
+            ),
+            key=step_number,
+            reverse=True,
+        )
+        for p in loader_only[2:]:
+            shutil.rmtree(p, ignore_errors=True)
         return None
 
     # -- save ---------------------------------------------------------------

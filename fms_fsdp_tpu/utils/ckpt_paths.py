@@ -7,9 +7,15 @@ import os
 
 
 def is_step_ckp(path) -> bool:
-    """True for the step_<N>_ckp names Checkpointer.save writes."""
+    """True for the step_<N>_ckp names Checkpointer.save writes. The
+    middle must be numeric: a parked 'step_best_ckp' must be ignored by
+    every scanner, not crash its step_number sort."""
     name = os.path.basename(str(path))
-    return name.startswith("step_") and name.endswith("_ckp")
+    return (
+        name.startswith("step_")
+        and name.endswith("_ckp")
+        and name.split("_")[1].isdigit()
+    )
 
 
 def step_number(path) -> int:

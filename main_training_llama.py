@@ -77,7 +77,9 @@ def main(**kwargs):
         )
     local_batch = cfg.batch_size * (data_extent // world_size)
     if not cfg.use_dummy_dataset:
-        loader = get_data_loader(cfg, rank, world_size)
+        loader = get_data_loader(
+            cfg, rank, world_size, batch_multiplier=data_extent // world_size
+        )
     else:
         loader = get_dummy_loader(cfg, rank, world_size)
     if rank == 0:

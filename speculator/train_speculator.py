@@ -149,12 +149,15 @@ def main(**kwargs):
 
     # data: raw packed sequences (no causal shift), assembled into global
     # mesh-sharded batches covering the data-parallel extent
-    if not cfg.use_dummy_dataset:
-        train_loader = get_data_loader(cfg, rank, world_size, postprocess=[])
-    else:
-        train_loader = get_dummy_loader(cfg, rank, world_size)
     data_extent = data_parallel_extent(mesh)
     local_batch = cfg.batch_size * max(1, data_extent // world_size)
+    if not cfg.use_dummy_dataset:
+        train_loader = get_data_loader(
+            cfg, rank, world_size, postprocess=[],
+            batch_multiplier=max(1, data_extent // world_size),
+        )
+    else:
+        train_loader = get_dummy_loader(cfg, rank, world_size)
     feed = DeviceFeed(
         rebatch(train_loader, local_batch, cfg.batch_size), mesh, prefetch=2
     )

@@ -188,17 +188,33 @@ def test_no_checkpoint_starts_fresh(tmp_path):
 
 
 def test_cleanup_ignores_non_step_entries(tmp_path):
-    """Retention only touches step_<N>_ckp entries (ordered by the step
-    number in the name, not ctime); foreign files in the checkpoint
-    folder survive and never shadow real checkpoints on load."""
+    """Retention counts MODEL checkpoints (metadata.json) against the
+    quota, ordered by the step number in the name, not ctime; foreign
+    files survive; loader-only auto-save dirs never evict model
+    checkpoints, and those older than the oldest surviving model
+    checkpoint (unreachable by any resume) are pruned."""
     ck = Checkpointer(str(tmp_path), 1, "fsdp", rank=0)
     (tmp_path / "checkpoints").mkdir(parents=True, exist_ok=True)
     (tmp_path / "checkpoints" / "notes.txt").write_text("keep me")
     for i in (30, 10, 20):  # creation order != step order
         d = tmp_path / "checkpoints" / f"step_{i}_ckp"
         os.makedirs(d)
-        (d / "x").write_text("x")
+        (d / "metadata.json").write_text("{}")
+    # loader-only auto-save dirs live on the worker clock (may lag or
+    # lead trainer steps): the newest TWO survive regardless of how they
+    # compare to model-checkpoint numbers, older ones are pruned. A
+    # non-numeric step name must be ignored, not crash the scanners.
+    for i in (3, 5, 35):
+        d = tmp_path / "checkpoints" / f"step_{i}_ckp"
+        os.makedirs(d)
+        (d / "loader_state_0.pkl").write_text("x")
+    os.makedirs(tmp_path / "checkpoints" / "step_best_ckp")
     ck._cleanup()
     left = sorted(os.listdir(tmp_path / "checkpoints"))
     assert "notes.txt" in left
-    assert [x for x in left if x.startswith("step_")] == ["step_30_ckp"]
+    assert "step_best_ckp" in left
+    assert [x for x in left if x.startswith("step_") and x != "step_best_ckp"] == [
+        "step_30_ckp",
+        "step_35_ckp",
+        "step_5_ckp",
+    ]

