@@ -95,3 +95,49 @@ def test_realdata_train_checkpoint_resume(arrow_data, tmp_path, capsys, worker_m
     out2 = capsys.readouterr().out
     assert "start_step = 8" in out2, out2[-2000:]
     assert "step: 8" not in out2.split("start_step")[-1] or True
+
+
+def test_speculator_realdata_live_loader_save(arrow_data, tmp_path, capsys):
+    """Speculator training on real arrow data with process workers: the
+    interval checkpoint saves the LIVE loader through the worker command
+    channel (Checkpointer.save(dataloader=...) while workers run), next
+    to the in-worker auto-saves — the dual loader-save composition the
+    speculator path uniquely exercises."""
+    from speculator.train_speculator import main
+
+    ckpt = str(tmp_path / "spec_ckpt")
+    main(
+        model_arch="embedllama",
+        model_path="/nonexistent",  # random-init tiny base
+        data_path=arrow_data,
+        datasets="dataset_1",
+        weights="1",
+        file_type="arrow",
+        use_dummy_dataset=False,
+        ckpt_save_path=ckpt,
+        ckpt_load_path=ckpt,
+        batch_size=2,
+        num_workers=2,
+        worker_mode="process",
+        logical_shards=8,
+        seq_length=64,
+        vocab_size=256,
+        num_steps=6,
+        report_interval=2,
+        checkpoint_interval=4,
+        stage2_start_step=100,
+        n_speculator_heads=2,
+        speculator_width=32,
+        sharding_strategy="fsdp",
+        **TINY,
+    )
+    out = capsys.readouterr().out
+    ckpts = sorted(os.listdir(os.path.join(ckpt, "checkpoints")))
+    # step_6 is the final-step save ONLY (6 % interval 4 != 0, so no
+    # in-worker auto-save lands there): loader state in it proves the
+    # LIVE save went through the worker command channel
+    step6 = [c for c in ckpts if c.startswith("step_6_")]
+    assert step6, (ckpts, out[-2000:])
+    inside = os.listdir(os.path.join(ckpt, "checkpoints", step6[0]))
+    assert any("loader_state" in f for f in inside), inside
+    assert "metadata.json" in inside, inside
