@@ -72,6 +72,19 @@ def test_llama_export_from_orbax_ckpt(tmp_path):
         sd["model.norm.weight"], np.asarray(state["params"]["norm"])
     )
 
+    # a loader-only auto-save dir with a HIGHER step number (worker-clock
+    # lookahead writes these on real-data runs) must not shadow the model
+    # checkpoint: the params loader scans newest-first for model state
+    import os
+
+    lo = tmp_path / "checkpoints" / "step_99_ckp"
+    os.makedirs(lo)
+    (lo / "loader_state_0.pkl").write_text("x")
+    params2 = load_params(str(tmp_path / "checkpoints"), TINY)
+    np.testing.assert_array_equal(
+        np.asarray(params2["norm"]), np.asarray(state["params"]["norm"])
+    )
+
 
 def test_mamba_export_structure():
     cfg = MambaConfig(
