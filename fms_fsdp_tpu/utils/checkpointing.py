@@ -84,24 +84,16 @@ def load_params_only(load_path: str, init_params_fn):
     }
     state_dir = os.path.join(load_path, "state")
     if not os.path.isdir(state_dir):
-        # newest-first scan for a dir that holds MODEL state: loader
-        # auto-save dirs (loader_state only) interleave in the same
-        # folder and can carry higher step numbers (worker-clock
-        # lookahead) — same policy as Checkpointer._validate_ckp_path
-        candidates = sorted(
-            (
-                os.path.join(load_path, x)
-                for x in os.listdir(load_path)
-                if is_step_ckp(x)
-            )
-            if os.path.isdir(load_path)
-            else [],
+        # newest step dir holding a COMMITTED model checkpoint
+        # (metadata.json is written last, after wait_until_finished — the
+        # commit marker _validate_ckp_path keys on): loader-only
+        # auto-save dirs and torn mid-save dirs must both be skipped
+        latest = get_latest(
+            load_path,
+            qualifier=lambda p: is_step_ckp(p)
+            and os.path.isdir(p)
+            and "metadata.json" in os.listdir(p),
             key=step_number,
-            reverse=True,
-        )
-        latest = next(
-            (c for c in candidates if os.path.isdir(os.path.join(c, "state"))),
-            None,
         )
         assert latest is not None, f"no checkpoint under {load_path}"
         state_dir = os.path.join(latest, "state")

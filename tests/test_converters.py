@@ -75,8 +75,6 @@ def test_llama_export_from_orbax_ckpt(tmp_path):
     # a loader-only auto-save dir with a HIGHER step number (worker-clock
     # lookahead writes these on real-data runs) must not shadow the model
     # checkpoint: the params loader scans newest-first for model state
-    import os
-
     lo = tmp_path / "checkpoints" / "step_99_ckp"
     os.makedirs(lo)
     (lo / "loader_state_0.pkl").write_text("x")

@@ -50,6 +50,76 @@ def test_main_training_context_parallel(tmp_path, capsys):
     assert losses and losses[-1] < losses[0]
 
 
+def test_main_training_mamba_entry(tmp_path, capsys):
+    """The mamba ENTRY (shared-orchestration dispatch on MambaConfig):
+    tiny hybrid (1 mamba + 1 attention layer) trains and checkpoints —
+    the model/step factories have their own tests, this pins the entry
+    wiring (variant default, config dispatch, mamba_kernel knob)."""
+    import main_training_mamba
+
+    main_training_mamba.main(
+        use_dummy_dataset=True,
+        num_steps=6,
+        seq_length=64,
+        batch_size=2,
+        report_interval=3,
+        checkpoint_interval=6,
+        vocab_size=256,
+        sharding_strategy="fsdp",
+        ckpt_save_path=str(tmp_path),
+        ckpt_load_path=str(tmp_path),
+        **{
+            "MambaConfig.n_layer": 2,
+            "MambaConfig.d_model": 64,
+            "MambaConfig.d_intermediate": 96,
+            "MambaConfig.vocab_size": 256,
+            "MambaConfig.d_state": 16,
+            "MambaConfig.headdim": 32,
+            "MambaConfig.attn_layer_idx": (1,),
+            "MambaConfig.chunk_size": 32,
+        },
+    )
+    out = capsys.readouterr().out
+    losses = _losses(out)
+    assert losses and losses[-1] < losses[0], out[-2000:]
+    assert os.path.isdir(tmp_path / "checkpoints" / "step_6_ckp")
+
+
+def test_main_training_mixtral_entry(tmp_path, capsys):
+    """The mixtral ENTRY: tiny MoE trains, reports the moe_drop_frac
+    extra metric, and checkpoints."""
+    import main_training_mixtral
+
+    main_training_mixtral.main(
+        use_dummy_dataset=True,
+        num_steps=6,
+        seq_length=64,
+        batch_size=2,
+        report_interval=3,
+        checkpoint_interval=6,
+        vocab_size=256,
+        sharding_strategy="fsdp",
+        ckpt_save_path=str(tmp_path),
+        ckpt_load_path=str(tmp_path),
+        **{
+            "MixtralConfig.nlayers": 2,
+            "MixtralConfig.emb_dim": 64,
+            "MixtralConfig.nheads": 4,
+            "MixtralConfig.kvheads": 2,
+            "MixtralConfig.hidden_dim": 96,
+            "MixtralConfig.num_experts": 4,
+            "MixtralConfig.top_k": 2,
+            "MixtralConfig.src_vocab_size": 256,
+            "MixtralConfig.max_expected_seq_len": 64,
+        },
+    )
+    out = capsys.readouterr().out
+    losses = _losses(out)
+    assert losses and losses[-1] < losses[0], out[-2000:]
+    assert "moe_drop_frac" in out
+    assert os.path.isdir(tmp_path / "checkpoints" / "step_6_ckp")
+
+
 def test_main_training_dummy_and_resume(tmp_path, capsys):
     common = dict(
         model_variant="llama2_7b",

@@ -123,6 +123,11 @@ def test_speculator_realdata_live_loader_save(arrow_data, tmp_path, capsys):
     from speculator.train_speculator import main
 
     ckpt = str(tmp_path / "spec_ckpt")
+    # pre-arm the on-demand checkpoint flag (ref:train_speculator_utils.py:
+    # 246-260): the first step boundary must save and reset the flag
+    os.makedirs(ckpt, exist_ok=True)
+    with open(os.path.join(ckpt, "do_ckpt"), "w") as f:
+        f.write("1")
     main(
         model_arch="embedllama",
         model_path="/nonexistent",  # random-init tiny base
@@ -158,3 +163,9 @@ def test_speculator_realdata_live_loader_save(arrow_data, tmp_path, capsys):
     inside = os.listdir(os.path.join(ckpt, "checkpoints", step6[0]))
     assert any("loader_state" in f for f in inside), inside
     assert "metadata.json" in inside, inside
+    # the pre-armed do_ckpt flag fired at step 1 (a non-interval step)
+    # and was reset to '0' after the save
+    step1 = [c for c in ckpts if c.startswith("step_1_")]
+    assert step1, ckpts
+    with open(os.path.join(ckpt, "do_ckpt")) as f:
+        assert f.read().strip() == "0"

@@ -6,6 +6,8 @@ init on the deterministic dummy stream."""
 
 import os
 
+import pytest
+
 import main_training_llama
 import eval_ppl
 
@@ -54,8 +56,6 @@ def test_eval_ppl_from_entry_checkpoint(tmp_path, capsys):
     # (A nonexistent ckpt_load_path hard-fails by design.)
     fresh = eval_ppl.main(ckpt_load_path="", eval_batches=4, **COMMON)
     assert fresh["ppl"] > trained["ppl"] * 1.5, (fresh, trained)
-
-    import pytest
 
     with pytest.raises(AssertionError, match="no checkpoint"):
         eval_ppl.main(
