@@ -55,6 +55,24 @@ if __name__ == "__main__":
         import main_training_llama as entry
 
         kw.update(sharding_strategy="fsdp", **LLAMA_TINY)
+    elif mode == "fsdp_data":
+        # real arrow data across the process boundary: each process owns
+        # a disjoint loader partition (rank=process_index), assembles its
+        # local rows into the global batch, and auto-saves its own
+        # loader_state shards next to the multi-process Orbax commit
+        import main_training_llama as entry
+
+        kw.update(
+            sharding_strategy="fsdp",
+            use_dummy_dataset=False,
+            data_path=sys.argv[3],
+            datasets="dataset_1",
+            weights="1",
+            file_type="arrow",
+            logical_shards=8,
+            num_workers=2,
+            **LLAMA_TINY,
+        )
     elif mode == "cp":
         # ring attention's ppermute crossing the process boundary
         import main_training_llama as entry

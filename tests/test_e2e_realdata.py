@@ -24,27 +24,32 @@ TINY = {
 }
 
 
-@pytest.fixture(scope="module")
-def arrow_data(tmp_path_factory):
-    """One dataset of 3 shards x 60 docs of 90 tokens (vocab < 256)."""
-    root = tmp_path_factory.mktemp("e2e_data")
+def build_arrow_dataset(root):
+    """One dataset of 3 shards x 60 docs of 90 tokens (vocab < 256).
+    Shared with the cross-process data test (tests/test_multiprocess.py)."""
+    root = str(root)
     schema = pa.schema([pa.field("tokens", pa.uint32())])
-    os.makedirs(root / "dataset_1")
+    os.makedirs(os.path.join(root, "dataset_1"))
     rng = np.random.default_rng(11)
     rows = []
     for s in range(3):
-        path = root / "dataset_1" / f"shard_{s}.arrow"
-        with pa.ipc.new_file(str(path), schema) as w:
+        path = os.path.join(root, "dataset_1", f"shard_{s}.arrow")
+        with pa.ipc.new_file(path, schema) as w:
             for _ in range(60):
                 doc = rng.integers(1, 255, size=90, dtype=np.uint32)
                 w.write(pa.record_batch([pa.array(doc)], schema))
         rows.append((f"/dataset_1/shard_{s}.arrow", 60, 60 * 90))
-    os.makedirs(root / "meta")
-    with open(root / "meta" / "combined_counts.csv", "w") as f:
+    os.makedirs(os.path.join(root, "meta"))
+    with open(os.path.join(root, "meta", "combined_counts.csv"), "w") as f:
         f.write("dataset/filename,documents,tokens\n")
         for name, d, t in rows:
             f.write(f"{name},{d},{t}\n")
-    return str(root)
+    return root
+
+
+@pytest.fixture(scope="module")
+def arrow_data(tmp_path_factory):
+    return build_arrow_dataset(tmp_path_factory.mktemp("e2e_data"))
 
 
 def _losses(out):
