@@ -149,9 +149,21 @@ def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh, kernel="auto", quant="non
     )
     A = -jnp.exp(p["A_log"].astype(jnp.float32))
 
-    y = ssd_scan(
-        xs, dt, A, Bm, Cm, p["D"], chunk_size=cfg.chunk_size, kernel=kernel
-    )
+    if mesh is not None and mesh.shape[AXIS_CONTEXT] > 1:
+        # sequence sharded over the context axis: pass the inter-chunk
+        # state across devices explicitly (ops/ssd.py::ssd_scan_cp) —
+        # long context for the Mamba family, O(S/cp) per device, instead
+        # of letting GSPMD gather the sequence around the chunk scan
+        from fms_fsdp_tpu.ops.ssd import ssd_scan_cp
+
+        y = ssd_scan_cp(
+            xs, dt, A, Bm, Cm, p["D"], mesh=mesh, chunk_size=cfg.chunk_size,
+            kernel=kernel,  # accepted for parity; the cp core is XLA
+        )
+    else:
+        y = ssd_scan(
+            xs, dt, A, Bm, Cm, p["D"], chunk_size=cfg.chunk_size, kernel=kernel
+        )
     y = y.reshape(B, S, d_inner)
 
     # gated RMSNorm: norm(y * silu(z)) (mamba2 norm_before_gate=False)

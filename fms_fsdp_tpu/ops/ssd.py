@@ -232,6 +232,28 @@ def _ssd_core_pallas_bwd_rule(L, interpret, res, cot):
 _ssd_core_pallas.defvjp(_ssd_core_pallas_fwd_rule, _ssd_core_pallas_bwd_rule)
 
 
+def _state_contribution(Cc, state, cum, G):
+    """exp(cum)-decayed contribution of a carried state to the outputs:
+    Cc (B, T, G, N) operand dtype, state (B, H, P, N) fp32, cum (B, T, H)
+    fp32 (inclusive cumsum of a) -> (B, T, H, P) fp32. Shared by the
+    chunk body's inter-chunk term and the context-parallel initial-state
+    correction — their algebra (including the operand-dtype cast feeding
+    the matmul) must stay identical for cp/single-device parity."""
+    Bsz, T, G_, N = Cc.shape
+    H = cum.shape[-1]
+    R = H // G
+    P = state.shape[-2]
+    return (
+        jnp.exp(cum).reshape(Bsz, T, G, R, 1)
+        * jnp.einsum(
+            "btgn,bgrpn->btgrp",
+            Cc,
+            state.reshape(Bsz, G, R, P, N).astype(Cc.dtype),
+            preferred_element_type=jnp.float32,
+        )
+    ).reshape(Bsz, T, H, P)
+
+
 def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G):
     """One chunk of the SSD scan (XLA formulation; also the recompute
     backward of the fused Pallas kernel). Intra-chunk quadratic term and
@@ -260,15 +282,7 @@ def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G):
     y, states = _intra_and_states_xla(xc, dtc, ac, Bc, Cc, G)
 
     # inter-chunk output: exp(cum_i) * C_i . s_prev, grouped over (b, g)
-    y = y + (
-        jnp.exp(cum).reshape(Bsz, L, G, R, 1)
-        * jnp.einsum(
-            "blgn,bgrpn->blgrp",
-            Cc,
-            s_prev.reshape(Bsz, G, R, P, N).astype(od),
-            preferred_element_type=f32,
-        )
-    ).reshape(Bsz, L, H, P)
+    y = y + _state_contribution(Cc, s_prev, cum, G)
 
     # state update: s_new = exp(total) * s_prev + chunk state contribution
     s_new = jnp.exp(total[:, 0, :])[:, :, None, None] * s_prev + states
@@ -310,9 +324,11 @@ def ssd_scan(x, dt, A, Bm, Cm, D=None, chunk_size: int = 256, kernel: str = "aut
     return y.astype(x.dtype)
 
 
-def _ssd_core_xla(x, dtf, a, Bm, Cm, L):
+def _ssd_core_xla(x, dtf, a, Bm, Cm, L, return_state: bool = False):
     """Checkpointed chunk scan over the XLA einsum formulation.
-    Returns y (B, S, H, P) fp32 (no D term)."""
+    Returns y (B, S, H, P) fp32 (no D term); with ``return_state`` also
+    the final carried state (B, H, P, N) fp32 — the context-parallel
+    wrapper passes it across devices."""
     Bsz, S, H, P = x.shape
     G, N = Bm.shape[2], Bm.shape[3]
     C = S // L
@@ -331,8 +347,96 @@ def _ssd_core_xla(x, dtf, a, Bm, Cm, L):
         return s_new, y_c
 
     init = jnp.zeros((Bsz, H, P, N), jnp.float32)
-    _, ys = lax.scan(body, init, (xc, dtc, ac, Bc, Cc))
-    return jnp.moveaxis(ys, 0, 1).reshape(Bsz, S, H, P)
+    s_fin, ys = lax.scan(body, init, (xc, dtc, ac, Bc, Cc))
+    y = jnp.moveaxis(ys, 0, 1).reshape(Bsz, S, H, P)
+    if return_state:
+        return y, s_fin
+    return y
+
+
+def ssd_scan_cp(
+    x, dt, A, Bm, Cm, D=None, *, mesh, chunk_size: int = 256, kernel: str = "auto"
+):
+    """Context-parallel chunked SSD: S sharded over the mesh's context
+    axis, state passed explicitly across devices — long context for the
+    Mamba family the way ring attention provides it for Llama (the
+    reference has no context parallelism at all; without this, GSPMD
+    partitions the chunk scan by gathering the sequence).
+
+    Correctness rests on the linearity of the recurrence in the carried
+    state: each device runs its local chunk scan with ZERO initial state
+    (producing y0 and its final state Z_d), the per-device true initial
+    state is the tiny linear recurrence
+
+        IN_0 = 0;  IN_d = T_{d-1} * IN_{d-1} + Z_{d-1}
+
+    over total local decays T_d = exp(sum_local a) (an unrolled cp-step
+    loop over all_gather'd (Z, T) pairs — cp is small), and the initial
+    state's contribution to outputs is the same grouped einsum the chunk
+    body uses for its inter-chunk term:  y_t += exp(cumsum_t a) * C_t . IN.
+    Differentiable end-to-end (shard_map + all_gather transpose); the
+    local scan keeps its checkpointed body. The local core is always the
+    XLA formulation — ``kernel`` is accepted for signature parity with
+    ``ssd_scan`` but "pallas" does not apply here (and "auto" resolves
+    to XLA on the single-device path too, by chip measurement).
+    """
+    del kernel
+    from jax import shard_map  # jax >= 0.8 API (check_vma kwarg)
+    from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, DATA_AXES
+    from fms_fsdp_tpu.parallel.sharding import resolve_spec
+    from jax.sharding import PartitionSpec as P
+
+    cp = mesh.shape[AXIS_CONTEXT]
+    if cp == 1:
+        return ssd_scan(x, dt, A, Bm, Cm, D, chunk_size=chunk_size)
+    Bsz, S, H, Pd = x.shape
+    G, N = Bm.shape[2], Bm.shape[3]
+    assert S % cp == 0, f"context axis ({cp}) must divide sequence {S}"
+    L = min(chunk_size, S // cp)
+    assert (S // cp) % L == 0, (
+        f"local sequence {S // cp} must be a multiple of chunk {L}"
+    )
+    od = x.dtype
+    f32 = jnp.float32
+
+    spec_x = resolve_spec(P(DATA_AXES, AXIS_CONTEXT, None, None), x.shape, mesh)
+    spec_dt = P(spec_x[0], AXIS_CONTEXT, None)
+    spec_bc = resolve_spec(
+        P(spec_x[0], AXIS_CONTEXT, None, None), Bm.shape, mesh
+    )
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec_x, spec_dt, P(None), spec_bc, spec_bc),
+        out_specs=spec_x,
+        check_vma=False,
+    )
+    def inner(x, dt, A, Bm, Cm):
+        b, s_loc = x.shape[0], x.shape[1]  # local (sharded) sizes
+        dtf = dt.astype(f32)
+        a = dtf * A.astype(f32)[None, None, :]
+        y0, z_fin = _ssd_core_xla(x, dtf, a, Bm, Cm, L, return_state=True)
+        t_total = jnp.exp(jnp.sum(a, axis=1))  # (b, H) local decay product
+
+        zs = lax.all_gather(z_fin, AXIS_CONTEXT)  # (cp, b, H, P, N)
+        ts = lax.all_gather(t_total, AXIS_CONTEXT)  # (cp, b, H)
+        idx = lax.axis_index(AXIS_CONTEXT)
+        carry = jnp.zeros_like(z_fin)
+        for d in range(cp - 1):  # unrolled: reverse-differentiable
+            upd = ts[d][..., None, None] * carry + zs[d]
+            carry = jnp.where(d < idx, upd, carry)
+
+        # initial-state contribution to every local position (same
+        # helper as the chunk body's inter-chunk term — shared algebra
+        # is what the parity argument rests on)
+        cum = jnp.cumsum(a, axis=1)  # (b, s_loc, H)
+        return (y0 + _state_contribution(Cm, carry, cum, G)).astype(f32)
+
+    y = inner(x, dt, A, Bm, Cm)
+    if D is not None:  # skip-connection term, elementwise (GSPMD-sharded)
+        y = y + D.astype(f32)[None, None, :, None] * x.astype(f32)
+    return y.astype(od)
 
 
 def ssd_scan_reference(x, dt, A, Bm, Cm, D=None):
