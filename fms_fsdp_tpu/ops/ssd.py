@@ -389,8 +389,7 @@ def ssd_scan_cp(
     cp = mesh.shape[AXIS_CONTEXT]
     if cp == 1:
         return ssd_scan(x, dt, A, Bm, Cm, D, chunk_size=chunk_size)
-    Bsz, S, H, Pd = x.shape
-    G, N = Bm.shape[2], Bm.shape[3]
+    S, G = x.shape[1], Bm.shape[2]
     assert S % cp == 0, f"context axis ({cp}) must divide sequence {S}"
     L = min(chunk_size, S // cp)
     assert (S // cp) % L == 0, (
@@ -413,7 +412,6 @@ def ssd_scan_cp(
         check_vma=False,
     )
     def inner(x, dt, A, Bm, Cm):
-        b, s_loc = x.shape[0], x.shape[1]  # local (sharded) sizes
         dtf = dt.astype(f32)
         a = dtf * A.astype(f32)[None, None, :]
         y0, z_fin = _ssd_core_xla(x, dtf, a, Bm, Cm, L, return_state=True)

@@ -120,6 +120,34 @@ if __name__ == "__main__":
             tensor_parallel_size=2,
             **LLAMA_TINY,
         )
+    elif mode == "mamba_cp":
+        # context-parallel SSD state passing (all_gather + cross-device
+        # initial-state recurrence) across the process boundary, plus
+        # ring attention on the hybrid's interleaved attention layer
+        import main_training_mamba as entry
+
+        from fms_fsdp_tpu.models.configs import MambaAttnConfig
+
+        kw.update(
+            sharding_strategy="fsdp",
+            context_parallel_size=2,
+            attention_kernel="xla",
+            **{
+                "MambaConfig.n_layer": 2,
+                "MambaConfig.d_model": 64,
+                "MambaConfig.d_intermediate": 96,
+                "MambaConfig.vocab_size": 256,
+                "MambaConfig.d_state": 16,
+                "MambaConfig.headdim": 32,
+                "MambaConfig.attn_layer_idx": (1,),
+                # tiny attention too — the 9.8b default attn_cfg would
+                # give the test's one attention layer 64x4096 projections
+                "MambaConfig.attn_cfg": MambaAttnConfig(
+                    head_dim=16, num_heads=4, num_heads_kv=2, rotary_emb_dim=8
+                ),
+                "MambaConfig.chunk_size": 16,
+            },
+        )
     elif mode == "ep":
         # MoE expert-parallel all-to-all crossing the process boundary
         import main_training_mixtral as entry

@@ -28,7 +28,8 @@ def _free_port():
 
 
 @pytest.mark.parametrize(
-    "mode", ["fsdp", "fsdp_data", "cp", "cp_pallas", "hsdp_tp", "ep"]
+    "mode",
+    ["fsdp", "fsdp_data", "cp", "cp_pallas", "hsdp_tp", "ep", "mamba_cp"],
 )
 def test_two_process_train(tmp_path, mode):
     # wall-clock bound: the communicate(timeout=840) below kills both
@@ -39,7 +40,8 @@ def test_two_process_train(tmp_path, mode):
     # mode) inside the cross-process ring — kernel+collective composition;
     # hsdp_tp = 2-D HSDP with the replica (DCN-analog) axis crossing the
     # process boundary, composed with a tensor axis;
-    # ep = the MoE expert-parallel all-to-all across the process boundary.
+    # ep = the MoE expert-parallel all-to-all across the process boundary;
+    # mamba_cp = context-parallel SSD state passing across the boundary.
     port = _free_port()
     ckpt = str(tmp_path / "ckpt")
     extra_argv = []
