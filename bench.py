@@ -281,6 +281,23 @@ ROWS = [
             flash_variant="kvgrid",
         ),
     ),
+    # mamba long context on one chip: the SSD scan is O(S) with a fixed
+    # (P, N) state, so the hybrid family has no sequence cap either
+    (
+        "mamba_9.8b-shaped (L=2, 32k vocab) bs=1 fullAC bf16 seq=16384 fusedCE",
+        dict(
+            variant="mamba_9.8b",
+            batch_size=1,
+            sel_ac=1,
+            seq_length=16384,
+            fused_loss=True,
+            model_overrides={
+                "n_layer": 2,
+                "attn_layer_idx": (),
+                "vocab_size": 32000,
+            },
+        ),
+    ),
 ]
 
 

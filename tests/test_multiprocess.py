@@ -19,6 +19,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHILD = os.path.join(REPO, "tests", "_mp_child.py")
+RING_CHILD = os.path.join(REPO, "tests", "_mp_ring_child.py")
 
 
 def _free_port():
@@ -35,13 +36,13 @@ def test_two_process_train(tmp_path, mode):
     # wall-clock bound: the communicate(timeout=840) below kills both
     # ranks on a hang (pytest-timeout isn't installed in this image).
     # Modes: fsdp = cross-process param all-gather/reduce-scatter;
-    # cp = ring attention's ppermute across the process boundary;
-    # cp_pallas = same ring, with the Pallas flash partials (interpret
-    # mode) inside the cross-process ring — kernel+collective composition;
+    # cp = ring attention inside a cross-process world (see NOTE below);
+    # cp_pallas = same, with the Pallas flash partials (interpret mode)
+    # in the ring — kernel+collective composition;
     # hsdp_tp = 2-D HSDP with the replica (DCN-analog) axis crossing the
     # process boundary, composed with a tensor axis;
     # ep = the MoE expert-parallel all-to-all across the process boundary;
-    # mamba_cp = context-parallel SSD state passing across the boundary.
+    # mamba_cp = context-parallel SSD inside a cross-process world.
     port = _free_port()
     ckpt = str(tmp_path / "ckpt")
     extra_argv = []
@@ -49,6 +50,13 @@ def test_two_process_train(tmp_path, mode):
         from tests.test_e2e_realdata import build_arrow_dataset
 
         extra_argv = [build_arrow_dataset(tmp_path / "data")]
+    # NOTE on the cp-family modes: the mesh places the context axis
+    # innermost (adjacent devices — right for ICI on real pods), so with
+    # contiguous per-process device blocks the context collectives here
+    # run INTRA-process; these modes cover the cp computation composed
+    # with cross-process fsdp collectives in one program. The context
+    # axis itself crosses the gloo boundary in test_ring_ops_cross_process
+    # below (1 device per process, op-level).
     procs = []
     for pid in range(2):
         env = dict(os.environ)
@@ -112,3 +120,44 @@ def test_two_process_train(tmp_path, mode):
         final_dir = os.path.join(ckpt, "checkpoints", f"step_{final}_ckp")
         states = [f for f in os.listdir(final_dir) if "loader_state" in f]
         assert len(states) == 4, os.listdir(final_dir)
+
+
+def test_ring_ops_cross_process(tmp_path):
+    """The context axis ON the process boundary (2 processes x 1 device):
+    ring attention's ppermute and ssd_scan_cp's all_gather + state
+    recurrence execute over gloo, outputs checked shard-by-shard against
+    single-device references inside each rank (see _mp_ring_child.py for
+    why the entry-level cp modes cannot produce this topology)."""
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=1",
+            COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            NUM_PROCESSES="2",
+            PROCESS_ID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-u", RING_CHILD],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+                cwd=REPO,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out[-4000:]}"
+        assert "RING_OPS_OK" in out, out[-2000:]
