@@ -82,6 +82,27 @@ def main():
             np.asarray(shard.data), ref[shard.index], atol=2e-5
         )
 
+        # backward over the boundary too: the ring bwd's ppermute
+        # transpose (traveling dk/dv accumulators) crosses gloo here
+        ref_gq = np.asarray(
+            jax.grad(
+                lambda q: jnp.sum(
+                    xla_attention(q, jnp.asarray(k), jnp.asarray(v)) ** 2
+                )
+            )(jnp.asarray(q))
+        )
+        gq = jax.jit(
+            jax.grad(
+                lambda q, k, v: jnp.sum(
+                    ring_attention(q, k, v, mesh, causal=True) ** 2
+                )
+            )
+        )(qg, kg, vg)
+        gshard = gq.addressable_shards[0]
+        np.testing.assert_allclose(
+            np.asarray(gshard.data), ref_gq[gshard.index], atol=5e-4
+        )
+
     # ---- context-parallel SSD: state passed across the process boundary
     b, s, h, p, g, n = 1, 128, 4, 8, 2, 8
     x = rng.standard_normal((b, s, h, p), dtype=np.float32)
@@ -107,6 +128,34 @@ def main():
     yshard = yg.addressable_shards[0]
     np.testing.assert_allclose(
         np.asarray(yshard.data), ref_y[yshard.index], atol=2e-5
+    )
+
+    # and the cp-SSD backward: the all_gather transpose (psum_scatter)
+    # over the state pairs crosses the process boundary
+    ref_gx = np.asarray(
+        jax.grad(
+            lambda x: jnp.sum(
+                ssd_scan(
+                    x, jnp.asarray(dt), jnp.asarray(A),
+                    jnp.asarray(Bm), jnp.asarray(Cm), chunk_size=32,
+                )
+                ** 2
+            )
+        )(jnp.asarray(x))
+    )
+    gx = jax.jit(
+        jax.grad(
+            lambda x, dt, Bm, Cm: jnp.sum(
+                ssd_scan_cp(
+                    x, dt, jnp.asarray(A), Bm, Cm, mesh=mesh, chunk_size=32
+                )
+                ** 2
+            )
+        )
+    )(xg, dtg, bg, cg)
+    gxshard = gx.addressable_shards[0]
+    np.testing.assert_allclose(
+        np.asarray(gxshard.data), ref_gx[gxshard.index], atol=5e-4
     )
 
     # ---- MoE expert-parallel all-to-all with the expert axis ON the
