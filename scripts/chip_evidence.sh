@@ -21,7 +21,8 @@ fi
 log "chip is up"
 
 log "1/4 bench.py (full row sweep, subprocess watchdogs)"
-timeout 7500 python bench.py | tee CHIP_BENCH.json || log "bench.py failed"
+# 10 rows x 900s worst-case watchdog each; typical ~2-5 min/row
+timeout 10000 python bench.py | tee CHIP_BENCH.json || log "bench.py failed"
 
 log "2/4 bench_kernels.py"
 timeout 2400 python scripts/bench_kernels.py || log "bench_kernels failed"
