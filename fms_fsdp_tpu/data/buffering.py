@@ -10,7 +10,12 @@ from typing import Any, Callable, List
 import numpy as np
 
 from fms_fsdp_tpu.data.stateful import StatefulDataset, WrapperDataset
-from fms_fsdp_tpu.utils.ckpt_paths import get_latest, is_step_ckp, step_number
+from fms_fsdp_tpu.utils.ckpt_paths import (
+    get_latest,
+    is_step_ckp,
+    safe_listdir,
+    step_number,
+)
 
 _EMPTY = np.empty(0, dtype=np.int64)
 
@@ -249,7 +254,7 @@ class CheckpointDataset(WrapperDataset):
         )
         for cand in candidates:
             if os.path.isdir(cand) and any(
-                "loader" in x for x in os.listdir(cand)
+                "loader" in x for x in safe_listdir(cand)
             ):
                 if verbose:
                     self.report(f"Checkpoint detected at {cand}")

@@ -308,9 +308,13 @@ class StatefulDataLoader:
         ]
         skew = [p - c for p, c in zip(produced, self._consumed)]
         if any(s > 0 for s in skew):
+            # the inflated worker rank // num_workers recovers the data
+            # rank, so merged multi-host logs attribute each skew list
+            rank = self.pipelines[0].rank // self.num_workers
             print(
-                f"loader {op}: worker prefetch ran {skew} batches ahead of "
-                f"consumption (per worker); resume will skip those batches"
+                f"loader {op} [rank {rank}]: worker prefetch ran {skew} "
+                f"batches ahead of consumption (per worker); resume will "
+                f"skip those batches"
             )
 
     def __iter__(self):

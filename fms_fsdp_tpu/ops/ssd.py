@@ -380,7 +380,6 @@ def ssd_scan_cp(
     ``ssd_scan`` but "pallas" does not apply here (and "auto" resolves
     to XLA on the single-device path too, by chip measurement).
     """
-    del kernel
     from jax import shard_map  # jax >= 0.8 API (check_vma kwarg)
     from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, DATA_AXES
     from fms_fsdp_tpu.parallel.sharding import resolve_spec
@@ -388,7 +387,20 @@ def ssd_scan_cp(
 
     cp = mesh.shape[AXIS_CONTEXT]
     if cp == 1:
-        return ssd_scan(x, dt, A, Bm, Cm, D, chunk_size=chunk_size)
+        # no context axis: the single-device path honors the kernel
+        # request in full (including an explicit 'pallas')
+        return ssd_scan(x, dt, A, Bm, Cm, D, chunk_size=chunk_size, kernel=kernel)
+    if kernel == "pallas":
+        # don't silently relabel a benchmark: an explicit 'pallas' request
+        # reaching the cp path still runs the XLA core under the context
+        # axis (ADVICE r4) — warn so comparisons stay honest
+        import warnings
+
+        warnings.warn(
+            "ssd_scan_cp: kernel='pallas' has no cp implementation; "
+            "running the XLA core under the context axis",
+            stacklevel=2,
+        )
     S, G = x.shape[1], Bm.shape[2]
     assert S % cp == 0, f"context axis ({cp}) must divide sequence {S}"
     L = min(chunk_size, S // cp)

@@ -38,6 +38,7 @@ from fms_fsdp_tpu.utils.ckpt_paths import (
     get_latest,
     get_oldest,
     is_step_ckp,
+    safe_listdir,
     step_number,
 )
 
@@ -92,7 +93,7 @@ def load_params_only(load_path: str, init_params_fn):
             load_path,
             qualifier=lambda p: is_step_ckp(p)
             and os.path.isdir(p)
-            and "metadata.json" in os.listdir(p),
+            and "metadata.json" in safe_listdir(p),
             key=step_number,
         )
         assert latest is not None, f"no checkpoint under {load_path}"
@@ -121,6 +122,10 @@ class Checkpointer:
     """Manages the checkpoint directory: rolling saves, resume detection,
     sharded (fsdp/hsdp) directory checkpoints or single-file (ddp) loads."""
 
+    # minimum local seconds a stale loader auto-save dir must hold an
+    # unchanged mtime across cleanup passes before it is pruned
+    PRUNE_QUIESCE_S = 60.0
+
     def __init__(
         self,
         ckpdir: str,
@@ -138,6 +143,9 @@ class Checkpointer:
         assert parallel_mode in ["fsdp", "hsdp", "ddp", "tp"]
         self.p_mode = parallel_mode
         self.report = self._selective_print if report_fn is None else report_fn
+        # loader-only prune candidates awaiting quiescence: path ->
+        # (newest mtime when marked, local time when marked)
+        self._prune_marks: dict = {}
 
         import orbax.checkpoint as ocp
 
@@ -181,7 +189,7 @@ class Checkpointer:
             for cand in candidates:
                 if os.path.isfile(cand):
                     return cand
-                if "metadata.json" in os.listdir(cand):
+                if "metadata.json" in safe_listdir(cand):
                     return cand
         return None
 
@@ -199,7 +207,7 @@ class Checkpointer:
 
         def is_model_ckp(p):
             return is_step_ckp(p) and (
-                os.path.isfile(p) or "metadata.json" in os.listdir(p)
+                os.path.isfile(p) or "metadata.json" in safe_listdir(p)
             )
 
         # the quota counts MODEL checkpoints only: loader auto-save dirs
@@ -245,8 +253,48 @@ class Checkpointer:
             key=step_number,
             reverse=True,
         )
-        for p in loader_only[2:]:
-            shutil.rmtree(p, ignore_errors=True)
+        def newest_mtime(p):
+            # newest mtime across the dir and its files: a growing
+            # loader_state file bumps its own mtime, not the directory's
+            try:
+                return max(
+                    [os.path.getmtime(p)]
+                    + [
+                        os.path.getmtime(os.path.join(p, f))
+                        for f in safe_listdir(p)
+                    ]
+                )
+            except OSError:
+                return None
+
+        # a straggler worker can still be writing its shard into an old
+        # step dir (its auto-save clock lags the fast workers'): prune a
+        # candidate only after its newest mtime holds STILL across two
+        # cleanup passes at least PRUNE_QUIESCE_S of local time apart.
+        # Progress is detected by mtime CHANGE, never by comparing an
+        # mtime against the local clock — shared-storage server clocks
+        # can lead or lag rank 0's by more than the window, which would
+        # make a wall-clock age test prune under an active writer (or
+        # never prune at all).
+        now = time.time()
+        marks = self._prune_marks
+        candidates = {p: newest_mtime(p) for p in loader_only[2:]}
+        for p, m in candidates.items():
+            if m is None:
+                marks.pop(p, None)
+                continue
+            marked = marks.get(p)
+            if marked is None or marked[0] != m:
+                marks[p] = (m, now)  # (re)arm: new candidate or still writing
+                continue
+            if now - marked[1] >= self.PRUNE_QUIESCE_S:
+                shutil.rmtree(p, ignore_errors=True)
+                marks.pop(p, None)
+        # drop marks for paths no longer candidates (pruned, promoted
+        # back inside the newest-two window, or externally removed)
+        for p in list(marks):
+            if p not in candidates:
+                marks.pop(p)
         return None
 
     # -- save ---------------------------------------------------------------

@@ -6,6 +6,17 @@ Shared by the model Checkpointer and the dataloader's auto-checkpoint layer.
 import os
 
 
+def safe_listdir(path) -> list:
+    """listdir that treats a concurrently-deleted (or not-a-dir) entry as
+    empty. Checkpoint-folder scanners enumerate candidate step dirs and
+    then inspect each; rank-0 retention pruning can rmtree a candidate
+    between those two steps, and the scanner must skip it, not crash."""
+    try:
+        return os.listdir(path)
+    except (FileNotFoundError, NotADirectoryError):
+        return []
+
+
 def is_step_ckp(path) -> bool:
     """True for the step_<N>_ckp names Checkpointer.save writes. The
     middle must be numeric: a parked 'step_best_ckp' must be ignored by

@@ -79,50 +79,62 @@ def main():
             return jnp.sum(fn(*a).astype(jnp.float32))
 
         t_f = time_fn(jax.jit(fn), *args, iters=20)
-        t_g = time_fn(jax.jit(jax.grad(loss, argnums=0)), *args, iters=10)
+        # differentiate w.r.t. EVERY operand (activations AND weights):
+        # a training step computes both dx and dw, so the timed backward
+        # must too or the 3x amortization overstates the rate (ADVICE r4)
+        t_g = time_fn(
+            jax.jit(jax.grad(loss, argnums=tuple(range(len(args))))),
+            *args,
+            iters=10,
+        )
         components.append(
             {
                 "component": name,
                 "fwd_ms": round(t_f * 1e3, 3),
                 "fwd_bwd_ms": round(t_g * 1e3, 3),
                 "fwd_tflops_per_s": round(flops_fwd / t_f / 1e12, 2),
-                # bwd of a GEMM chain is ~2x fwd FLOPs; grad-of-loss runs
-                # fwd+bwd so the amortized rate uses 3x
+                # bwd of a GEMM chain (dx + dw) is ~2x fwd FLOPs;
+                # grad-of-loss runs fwd+bwd so the amortized rate uses 3x
                 "fwd_bwd_tflops_per_s": round(3 * flops_fwd / t_g / 1e12, 2),
             }
         )
 
-    add("in_proj GEMM", lambda x: x @ w_in, (x,), _gemm_flops(tok, D, IN_PROJ))
+    add(
+        "in_proj GEMM",
+        lambda x, w: x @ w,
+        (x, w_in),
+        _gemm_flops(tok, D, IN_PROJ),
+    )
     add(
         "conv1d (shifted-FMA)",
-        lambda c: causal_conv1d(c, cw, cb),
-        (cx,),
+        lambda c, w, b: causal_conv1d(c, w, b),
+        (cx, cw, cb),
         2 * tok * CONV_C * CONV_W,
     )
     add(
         "ssd_scan (auto kernel)",
-        lambda xs: ssd_scan(xs, dt, A, Bm, Cm, Dm),
-        (xs,),
+        lambda xs, dt, A, Bm, Cm, Dm: ssd_scan(xs, dt, A, Bm, Cm, Dm),
+        (xs, dt, A, Bm, Cm, Dm),
         # dominant SSD terms: intra-chunk (S*chunk per head) + state IO;
         # count the matmul terms only (B*S*chunk*(N+P) per head family)
         2 * tok * H * (N * P * 2 + N * 256),
     )
     add(
         "out_proj GEMM",
-        lambda h: h.reshape(B, S, D_INNER) @ w_out,
-        (xs,),
+        lambda h, w: h.reshape(B, S, D_INNER) @ w,
+        (xs, w_out),
         _gemm_flops(tok, D_INNER, D),
     )
     add(
         "MLP (SwiGLU 2-GEMM core)",
-        lambda x: jax.nn.silu(x @ w1) @ w2,
-        (x,),
+        lambda x, w1, w2: jax.nn.silu(x @ w1) @ w2,
+        (x, w1, w2),
         _gemm_flops(tok, D, MLP_HID) * 2,
     )
     add(
         "lm_head GEMM",
-        lambda x: x @ w_head,
-        (x,),
+        lambda x, w: x @ w,
+        (x, w_head),
         _gemm_flops(tok, D, VOCAB),
     )
 

@@ -3,6 +3,7 @@ mesh resharding on load, single-file model loads, metadata, retention."""
 
 import os
 import pickle
+import time
 
 import jax
 import jax.numpy as jnp
@@ -209,6 +210,10 @@ def test_cleanup_ignores_non_step_entries(tmp_path):
         os.makedirs(d)
         (d / "loader_state_0.pkl").write_text("x")
     os.makedirs(tmp_path / "checkpoints" / "step_best_ckp")
+    # loader-only pruning is two-pass (quiescence guard): collapse the
+    # local-time window and run both passes
+    ck.PRUNE_QUIESCE_S = 0.0
+    ck._cleanup()
     ck._cleanup()
     left = sorted(os.listdir(tmp_path / "checkpoints"))
     assert "notes.txt" in left
@@ -218,3 +223,37 @@ def test_cleanup_ignores_non_step_entries(tmp_path):
         "step_35_ckp",
         "step_5_ckp",
     ]
+
+
+def test_cleanup_spares_inflight_loader_saves(tmp_path):
+    """A loader auto-save dir still being written must not be rmtree'd
+    under the writer, even when it falls outside the newest-two
+    retention window (ADVICE r4 race). Progress is detected by mtime
+    CHANGE between cleanup passes — never by comparing an mtime against
+    the local clock, which shared-storage clock skew defeats in both
+    directions."""
+    ck = Checkpointer(str(tmp_path), 1, "fsdp", rank=0)
+    ck.PRUNE_QUIESCE_S = 0.0
+    (tmp_path / "checkpoints").mkdir(parents=True, exist_ok=True)
+    d30 = tmp_path / "checkpoints" / "step_30_ckp"
+    os.makedirs(d30)
+    (d30 / "metadata.json").write_text("{}")
+    for i in (3, 5, 35):
+        d = tmp_path / "checkpoints" / f"step_{i}_ckp"
+        os.makedirs(d)
+        (d / "loader_state_0.pkl").write_text("x")
+    # pass 1 only arms the candidate — nothing is pruned yet
+    ck._cleanup()
+    assert "step_3_ckp" in os.listdir(tmp_path / "checkpoints")
+    # the writer makes progress between passes (mtime advances, value
+    # arbitrary — a skewed stamp far in the past still differs): spared
+    d3 = tmp_path / "checkpoints" / "step_3_ckp"
+    old = time.time() - 7200
+    os.utime(d3 / "loader_state_0.pkl", (old, old))
+    ck._cleanup()
+    assert "step_3_ckp" in os.listdir(tmp_path / "checkpoints")
+    # mtime holds still across a full window: pruned
+    ck._cleanup()
+    left = sorted(os.listdir(tmp_path / "checkpoints"))
+    assert "step_3_ckp" not in left
+    assert "step_5_ckp" in left and "step_35_ckp" in left

@@ -44,16 +44,20 @@ def test_ssd_cp_grads_match_full():
         MeshConfig(sharding_strategy="fsdp", context_parallel_size=2)
     )
 
-    def loss_full(x, dt, Bm, Cm):
+    # A and D ride into shard_map replicated (P(None) specs) — their
+    # cotangents flow through the psum-on-transpose path, which none of
+    # the sharded-operand grads exercise (ADVICE r4): cover all six
+    def loss_full(x, dt, Bm, Cm, A, D):
         return jnp.sum(ssd_scan(x, dt, A, Bm, Cm, D, chunk_size=32) ** 2)
 
-    def loss_cp(x, dt, Bm, Cm):
+    def loss_cp(x, dt, Bm, Cm, A, D):
         return jnp.sum(
             ssd_scan_cp(x, dt, A, Bm, Cm, D, mesh=mesh, chunk_size=32) ** 2
         )
 
-    ref = jax.grad(loss_full, argnums=(0, 1, 2, 3))(x, dt, Bm, Cm)
-    out = jax.jit(jax.grad(loss_cp, argnums=(0, 1, 2, 3)))(x, dt, Bm, Cm)
+    argnums = (0, 1, 2, 3, 4, 5)
+    ref = jax.grad(loss_full, argnums=argnums)(x, dt, Bm, Cm, A, D)
+    out = jax.jit(jax.grad(loss_cp, argnums=argnums))(x, dt, Bm, Cm, A, D)
     for a, b in zip(out, ref):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-4
