@@ -7,11 +7,10 @@ the same interval, and a resume that restores both."""
 
 import os
 
-import numpy as np
-import pyarrow as pa
 import pytest
 
 import main_training_llama
+from fms_fsdp_tpu.data.synth import build_arrow_corpus
 
 TINY = {
     "LlamaConfig.nlayers": 2,
@@ -26,25 +25,10 @@ TINY = {
 
 def build_arrow_dataset(root):
     """One dataset of 3 shards x 60 docs of 90 tokens (vocab < 256).
-    Shared with the cross-process data test (tests/test_multiprocess.py)."""
-    root = str(root)
-    schema = pa.schema([pa.field("tokens", pa.uint32())])
-    os.makedirs(os.path.join(root, "dataset_1"))
-    rng = np.random.default_rng(11)
-    rows = []
-    for s in range(3):
-        path = os.path.join(root, "dataset_1", f"shard_{s}.arrow")
-        with pa.ipc.new_file(path, schema) as w:
-            for _ in range(60):
-                doc = rng.integers(1, 255, size=90, dtype=np.uint32)
-                w.write(pa.record_batch([pa.array(doc)], schema))
-        rows.append((f"/dataset_1/shard_{s}.arrow", 60, 60 * 90))
-    os.makedirs(os.path.join(root, "meta"))
-    with open(os.path.join(root, "meta", "combined_counts.csv"), "w") as f:
-        f.write("dataset/filename,documents,tokens\n")
-        for name, d, t in rows:
-            f.write(f"{name},{d},{t}\n")
-    return root
+    Shared with the cross-process data test (tests/test_multiprocess.py);
+    the corpus itself (learnable counter docs) is the same generator the
+    chip-evidence eval leg scales up (fms_fsdp_tpu/data/synth.py)."""
+    return build_arrow_corpus(root)
 
 
 @pytest.fixture(scope="module")

@@ -101,8 +101,10 @@ def test_two_process_train(tmp_path, mode):
     ]
     assert len(losses) >= 2, outs[0][-3000:]
     if mode == "fsdp_data":
-        # random-token arrow shards: unlearnable in 6 steps — finite,
-        # vocab-scale loss proves the cross-process pipeline computed
+        # the shared arrow fixture now holds learnable counter docs
+        # (data/synth.py), but 6 steps is far too few to demand a loss
+        # drop: finite, vocab-scale loss proves the cross-process
+        # pipeline computed real batches
         import math
 
         assert all(math.isfinite(l) and 0 < l < 10 for l in losses), losses
