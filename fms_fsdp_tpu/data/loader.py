@@ -326,12 +326,28 @@ class StatefulDataLoader:
         for p in self.pipelines:
             p.setup()
         if self.num_workers == 1:
+            # workerless path: same generation contract as the worker
+            # paths — a later __iter__ (or shutdown) supersedes this
+            # iterator, which must raise rather than keep drawing from
+            # the shared pipeline interleaved with its successor
+            self.shutdown()
+            stop = self._stop = threading.Event()
             it = iter(self.pipelines[0])
             while True:
+                if stop.is_set():
+                    raise RuntimeError(
+                        "stale loader iterator: the loader was shut down "
+                        "or re-iterated; this generation's stream has "
+                        "ended"
+                    )
                 yield _stack([next(it) for _ in range(self.batch_size)])
 
         self.shutdown()
-        self._stop = threading.Event()  # fresh generation (see __init__)
+        # fresh generation (see __init__); the local binding lets THIS
+        # generator detect it was superseded — shutdown() (including the
+        # one a later __iter__ issues) sets the event, and a stale
+        # iterator must raise, not block forever on queues nobody fills
+        stop = self._stop = threading.Event()
         self._produced = [[0] for _ in range(self.num_workers)]
         self._consumed = [0] * self.num_workers
         queues = [
@@ -351,7 +367,23 @@ class StatefulDataLoader:
             t.start()
         w = 0
         while True:
-            batch = queues[w].get()
+            while True:
+                # checked BEFORE the get: a superseded iterator must not
+                # serve leftover prefetched batches either — the stream
+                # has moved to the new generation, and the skipped-
+                # prefetch contract says those batches are dropped, not
+                # delivered late interleaved with the successor's
+                if stop.is_set():
+                    raise RuntimeError(
+                        "stale loader iterator: the loader was shut down "
+                        "or re-iterated; this generation's stream has "
+                        "ended"
+                    )
+                try:
+                    batch = queues[w].get(timeout=1.0)
+                    break
+                except queue.Empty:
+                    continue
             if isinstance(batch, BaseException):
                 self.shutdown()
                 raise batch
@@ -376,13 +408,32 @@ class StatefulDataLoader:
         allocator; if a worker ever hangs before producing its first
         batch, the thread mode is the drop-in fallback."""
         if self._procs_started:
-            raise RuntimeError(
-                "worker_mode='process': pipeline state lives in the worker "
-                "processes; re-iterating would silently restart the stream "
-                "from the parent's pre-fork state. Build a fresh loader "
-                "(resume via load_from_path) instead."
-            )
+            if not self._workers_alive():
+                raise RuntimeError(
+                    "worker_mode='process': re-iteration after workers "
+                    "exited — their pipeline state is gone. Build a fresh "
+                    "loader (resume via load_from_path) instead."
+                )
+            # capture-then-refork: live workers hold the stream position,
+            # so a second __iter__ (an eval loop re-iterating its loader,
+            # torch DataLoader's normal contract) pulls each worker's
+            # state through the command channel, restores it into the
+            # parent's pipeline clones — the same same-size single-shard
+            # load the file-resume path uses — and falls through to fork
+            # a fresh generation that CONTINUES the stream. Batches the
+            # workers prefetched but the consumer never took are skipped,
+            # exactly like a checkpoint resume; _log_skew reports them.
+            states = self._command_all("state_dict")
+            self._log_skew("re-iteration")
+            for p, sd in zip(self.pipelines, states):
+                p.load_worldsize = p.worldsize
+                p.load_state_dict([sd], sharded_input=True)
         self.shutdown()
+        # same stale-iterator contract as thread mode: shutdown() (ours
+        # above, or a later __iter__'s) sets the old generation's event,
+        # and that generation's consumer raises instead of spinning on
+        # queues whose producers are gone
+        stop = self._stop = threading.Event()
         self._procs_started = True
         ctx = multiprocessing.get_context("fork")
         self._produced = [ctx.Value("q", 0) for _ in range(self.num_workers)]
@@ -403,17 +454,29 @@ class StatefulDataLoader:
             child_conn.close()
             self._cmds.append(parent_conn)
             self._procs.append(proc)
+        procs = self._procs  # generation-local (shutdown() rebinds the attr)
         w = 0
         while True:
             while True:
+                # pre-get staleness check, same contract as thread mode
+                if stop.is_set():
+                    raise RuntimeError(
+                        "stale loader iterator: the loader was shut down "
+                        "or re-iterated; this generation's stream has "
+                        "ended"
+                    )
                 try:
                     batch = queues[w].get(timeout=1.0)
                     break
                 except queue.Empty:
-                    if not self._procs or not self._procs[w].is_alive():
-                        exitcode = (
-                            self._procs[w].exitcode if self._procs else None
-                        )
+                    if stop.is_set():
+                        # deliberate shutdown/re-iteration, not a worker
+                        # crash: loop back so the top-of-loop check
+                        # raises the stale-iterator error, not a
+                        # misleading "worker died (exit -15)"
+                        continue
+                    if not procs[w].is_alive():
+                        exitcode = procs[w].exitcode
                         self.shutdown()
                         raise RuntimeError(
                             f"loader worker {w} died (exit {exitcode})"
