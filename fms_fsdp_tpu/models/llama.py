@@ -139,7 +139,7 @@ def attention_block(
 
         o = ring_attention(q, k, v, mesh, causal=True)
     else:
-        o = attention(q, k, v, causal=True, impl=attn_impl)
+        o = attention(q, k, v, causal=True, impl=attn_impl, mesh=mesh)
     o = qmatmul(o.reshape(b, s, nq * hd), layer["wo"], quant=quant)
     return x + _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 

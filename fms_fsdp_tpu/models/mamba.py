@@ -162,7 +162,8 @@ def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh, kernel="auto", quant="non
         )
     else:
         y = ssd_scan(
-            xs, dt, A, Bm, Cm, p["D"], chunk_size=cfg.chunk_size, kernel=kernel
+            xs, dt, A, Bm, Cm, p["D"], chunk_size=cfg.chunk_size,
+            kernel=kernel, mesh=mesh,
         )
     y = y.reshape(B, S, d_inner)
 
@@ -198,7 +199,7 @@ def _attn_mixer(x, p: Params, cfg: MambaConfig, cos, sin, attn_impl, mesh, quant
 
         o = ring_attention(q, k, v, mesh, causal=a.causal)
     else:
-        o = attention(q, k, v, causal=a.causal, impl=attn_impl)
+        o = attention(q, k, v, causal=a.causal, impl=attn_impl, mesh=mesh)
     o = qmatmul(o.reshape(B, S, a.num_heads * hd), p["wo"], quant=quant)
     return _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 

@@ -71,9 +71,63 @@ def configure_flash_variant(variant) -> None:
         _fa.set_kernel_variant(variant)
 
 
-def attention(q, k, v, *, causal: bool = True, impl: str = "auto"):
+def _flash_sharded(q, k, v, causal, mesh):
+    """Flash under shard_map on a multi-device mesh: batch over the data
+    axes, heads over the tensor axis (dropped when GQA q/kv head counts
+    would pair up differently), sequence whole — the context-axis case
+    routes to ring attention in the models before reaching here.
+
+    Required, not an optimization: a Mosaic kernel cannot be partitioned
+    by GSPMD, so an un-wrapped pallas_call on a >1-device mesh fails to
+    compile with "Mosaic kernels cannot be automatically partitioned"
+    (caught by scripts/aot_lower_kernels.py against a v5e topology — the
+    CPU multichip dryruns resolve impl='auto' to XLA and never see it)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from fms_fsdp_tpu.ops.pallas_mode import interpret_default
+    from fms_fsdp_tpu.parallel.mesh import AXIS_TENSOR, DATA_AXES
+    from fms_fsdp_tpu.parallel.sharding import resolve_spec
+
+    base = P(DATA_AXES, None, AXIS_TENSOR, None)
+    spec_q = resolve_spec(base, q.shape, mesh)
+    spec_kv = resolve_spec(base, k.shape, mesh)
+    if spec_q[2] != spec_kv[2]:
+        # q heads divide the tensor axis but kv heads don't (or vice
+        # versa): a split would mispair GQA groups — replicate heads
+        spec_q = P(spec_q[0], None, None, None)
+        spec_kv = P(spec_kv[0], None, None, None)
+    interpret = interpret_default()
+
+    def body(ql, kl, vl):
+        return _fa.flash_attention(
+            ql, kl, vl, causal=causal, interpret=interpret
+        )
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec_q, spec_kv, spec_kv),
+        out_specs=spec_q,
+        check_vma=False,
+    )(q, k, v)
+
+
+def _flash(q, k, v, causal, mesh):
+    if mesh is not None and mesh.size > 1:
+        return _flash_sharded(q, k, v, causal, mesh)
+    from fms_fsdp_tpu.ops.pallas_mode import interpret_default
+
+    return _fa.flash_attention(
+        q, k, v, causal=causal, interpret=interpret_default()
+    )
+
+
+def attention(q, k, v, *, causal: bool = True, impl: str = "auto", mesh=None):
     """Dispatch: Pallas flash kernel on TPU for eligible shapes (head_dim a
-    128-multiple, 256-aligned seq), XLA einsum otherwise."""
+    128-multiple, 256-aligned seq), XLA einsum otherwise. ``mesh`` must be
+    passed whenever the computation is jitted over a >1-device mesh — the
+    kernel then runs per-device under shard_map (see _flash_sharded)."""
     if impl == "pallas":
         if not HAS_PALLAS_FLASH or not _fa.supports(q.shape, k.shape):
             raise NotImplementedError(
@@ -81,12 +135,12 @@ def attention(q, k, v, *, causal: bool = True, impl: str = "auto"):
                 f"128-multiple head_dim and 256-aligned sequence lengths; "
                 f"got q{q.shape} k{k.shape}"
             )
-        return _fa.flash_attention(q, k, v, causal=causal)
+        return _flash(q, k, v, causal, mesh)
     if (
         impl == "auto"
         and HAS_PALLAS_FLASH
         and jax.default_backend() == "tpu"
         and _fa.supports(q.shape, k.shape)
     ):
-        return _fa.flash_attention(q, k, v, causal=causal)
+        return _flash(q, k, v, causal, mesh)
     return xla_attention(q, k, v, causal=causal)

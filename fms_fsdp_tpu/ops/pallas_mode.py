@@ -1,0 +1,20 @@
+"""Interpret-vs-compiled mode resolution shared by Pallas kernels.
+
+Production heuristic: interpret mode on CPU hosts (the test suite runs
+the kernels' real block algebra under a virtual mesh), compiled Mosaic
+on TPU. ``FMS_FORCE_COMPILED_PALLAS=1`` overrides to compiled even with
+a CPU default backend — the deviceless AOT validation path
+(scripts/aot_lower_kernels.py) traces kernels on a chipless host and
+compiles them against a TPU topology description, which must embed real
+Mosaic custom calls, not the interpret callback.
+"""
+
+import os
+
+import jax
+
+
+def interpret_default() -> bool:
+    if os.environ.get("FMS_FORCE_COMPILED_PALLAS") == "1":
+        return False
+    return jax.default_backend() == "cpu"

@@ -153,7 +153,9 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
     use_flash = _flash_eligible(q.shape, k.shape, cp)
-    interpret = jax.default_backend() == "cpu"
+    from fms_fsdp_tpu.ops.pallas_mode import interpret_default
+
+    interpret = interpret_default()
     s_local = q.shape[1] // cp
     bq = _pick_block(s_local, 512)
     bk = _pick_block(s_local, 512)

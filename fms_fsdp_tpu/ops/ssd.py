@@ -289,12 +289,22 @@ def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G):
     return y, s_new
 
 
-def ssd_scan(x, dt, A, Bm, Cm, D=None, chunk_size: int = 256, kernel: str = "auto"):
+def ssd_scan(
+    x, dt, A, Bm, Cm, D=None, chunk_size: int = 256, kernel: str = "auto",
+    mesh=None,
+):
     """Chunked selective scan: ``lax.scan`` over chunks with the fp32
     state carried across chunk boundaries; the chunk body is checkpointed
     so the backward pass recomputes one chunk's (L, L)-per-head
     intermediates at a time instead of saving them for the whole sequence.
-    Returns y with x's shape, computed in fp32, cast back to x.dtype."""
+    Returns y with x's shape, computed in fp32, cast back to x.dtype.
+
+    ``mesh`` must be passed when the computation is jitted over a
+    >1-device mesh AND the Pallas kernel is requested: a Mosaic kernel
+    cannot be partitioned by GSPMD, so the fused core then runs
+    per-device under shard_map with the batch over the data axes (the
+    context-axis case is ``ssd_scan_cp``'s job). The XLA core needs no
+    wrapping — GSPMD partitions it fine."""
     Bsz, S, H, P = x.shape
     G, N = Bm.shape[2], Bm.shape[3]
     L = min(chunk_size, S)
@@ -309,12 +319,55 @@ def ssd_scan(x, dt, A, Bm, Cm, D=None, chunk_size: int = 256, kernel: str = "aut
     # re-measured on chip (the r2 per-chunk kernel measured 2x slower
     # than the einsums — BENCH_SSD.json; the fused whole-sequence kernel
     # above removes the per-chunk relayouts + scan overhead it paid).
+    # The fused kernel's v5e lowering is machine-validated every change
+    # (scripts/aot_lower_kernels.py -> AOT_LOWER.json, fwd+bwd), so the
+    # r2 "never lowered" failure class cannot recur silently; the
+    # on-chip perf race that would flip this default is
+    # chip_evidence.sh step 3.
     mode = "xla" if kernel == "auto" else kernel
 
     if mode == "pallas":
-        y = _ssd_core_pallas(
-            x, dtf, a, Bm, Cm, L, jax.default_backend() == "cpu"
-        )
+        from fms_fsdp_tpu.ops.pallas_mode import interpret_default
+
+        interpret = interpret_default()
+        if mesh is not None and mesh.size > 1:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P_
+
+            from fms_fsdp_tpu.parallel.mesh import AXIS_TENSOR, DATA_AXES
+            from fms_fsdp_tpu.parallel.sharding import resolve_spec
+
+            # batch over the data axes, heads/groups over the tensor
+            # axis — the per-shard head->group mapping h // (H/G) stays
+            # contiguous when BOTH H and G divide the tensor extent;
+            # when only one does, a split would mispair them, so
+            # replicate the head dims (same guard as _flash_sharded)
+            s_x = resolve_spec(
+                P_(DATA_AXES, None, AXIS_TENSOR, None), x.shape, mesh
+            )
+            s_dt = resolve_spec(
+                P_(DATA_AXES, None, AXIS_TENSOR), dtf.shape, mesh
+            )
+            s_bc = resolve_spec(
+                P_(DATA_AXES, None, AXIS_TENSOR, None), Bm.shape, mesh
+            )
+            if s_x[2] != s_bc[2]:
+                s_x = P_(s_x[0], None, None, None)
+                s_dt = P_(s_dt[0], None, None)
+                s_bc = P_(s_bc[0], None, None, None)
+
+            def body(xl, dtl, al, Bl, Cl):
+                return _ssd_core_pallas(xl, dtl, al, Bl, Cl, L, interpret)
+
+            y = shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(s_x, s_dt, s_dt, s_bc, s_bc),
+                out_specs=s_x,
+                check_vma=False,
+            )(x, dtf, a, Bm, Cm)
+        else:
+            y = _ssd_core_pallas(x, dtf, a, Bm, Cm, L, interpret)
     else:
         y = _ssd_core_xla(x, dtf, a, Bm, Cm, L)
 
@@ -387,9 +440,13 @@ def ssd_scan_cp(
 
     cp = mesh.shape[AXIS_CONTEXT]
     if cp == 1:
-        # no context axis: the single-device path honors the kernel
-        # request in full (including an explicit 'pallas')
-        return ssd_scan(x, dt, A, Bm, Cm, D, chunk_size=chunk_size, kernel=kernel)
+        # no context axis: the plain path honors the kernel request in
+        # full (including an explicit 'pallas', shard_map-wrapped there
+        # if the mesh still spans devices on other axes)
+        return ssd_scan(
+            x, dt, A, Bm, Cm, D, chunk_size=chunk_size, kernel=kernel,
+            mesh=mesh,
+        )
     if kernel == "pallas":
         # don't silently relabel a benchmark: an explicit 'pallas' request
         # reaching the cp path still runs the XLA core under the context
