@@ -48,21 +48,34 @@ def _env_setup():
     jax.config.update("jax_platforms", "cpu")
 
 
-def _topology_mesh(shape=(1, 1, 1, 1, 1)):
+_USED_TOPOLOGY = None  # recorded per target into AOT_LOWER.json
+
+
+def _topology_mesh(shape=(1, 1, 1, 1, 1), topology=None):
     """5-axis Mesh over the deviceless v5e topology's devices. The
     default is a SINGLE-device mesh: an un-shard_mapped Mosaic kernel
     cannot be partitioned by GSPMD, so standalone-kernel targets compile
     single-chip (the bench-row configuration) while multi-device shapes
-    are for shard_map'd compositions and full train steps."""
+    are for shard_map'd compositions and full train steps. When the
+    requested mesh outgrows the configured topology, it scales up to the
+    2-host v5e:2x4, so 8-device programs compile with a REAL host
+    boundary in the device assignment; the topology actually used is
+    recorded in each result entry."""
+    global _USED_TOPOLOGY
     import numpy as np
     from jax.experimental import topologies
     from jax.sharding import Mesh
 
     from fms_fsdp_tpu.parallel.mesh import MESH_AXES
 
-    td = topologies.get_topology_desc(platform="tpu", topology_name=TOPOLOGY)
     n = int(np.prod(shape))
+    name = topology or TOPOLOGY
+    td = topologies.get_topology_desc(platform="tpu", topology_name=name)
+    if n > len(td.devices):
+        name = "v5e:2x4"
+        td = topologies.get_topology_desc(platform="tpu", topology_name=name)
     assert n <= len(td.devices), (shape, len(td.devices))
+    _USED_TOPOLOGY = name
     return Mesh(np.asarray(td.devices[:n]).reshape(shape), MESH_AXES), td
 
 
@@ -178,11 +191,13 @@ def _compile_cp_ssd(cp):
     ).compile()
 
 
-def _compile_train_step(variant, model_overrides, **cfg_overrides):
-    """AOT-compile the FULL donated jitted train step (the bench-row
-    configs) over a 4-way fsdp mesh of topology devices: Pallas kernels
-    + GSPMD partitioning + int8 GEMMs, compiled exactly as a v5e pod
-    slice would compile them."""
+def _compile_train_step(
+    variant, model_overrides, mesh_shape=(1, 4, 1, 1, 1), **cfg_overrides
+):
+    """AOT-compile the FULL donated jitted train step over a mesh of
+    topology devices (default: 4-way fsdp; the _2host targets pass
+    hsdp/cp/ep/tp shapes): Pallas kernels + GSPMD partitioning + int8
+    GEMMs, compiled exactly as a v5e pod slice would compile them."""
     import dataclasses
 
     import jax
@@ -201,19 +216,20 @@ def _compile_train_step(variant, model_overrides, **cfg_overrides):
     from fms_fsdp_tpu.utils.config_utils import get_model_config
     from jax.sharding import NamedSharding
 
-    cfg = TrainConfig(
+    cfg_kw = dict(
         model_variant=variant,
         sharding_strategy="fsdp",
         batch_size=2,
         seq_length=4096,
         attention_kernel="pallas",
-        **cfg_overrides,
     )
+    cfg_kw.update(cfg_overrides)
+    cfg = TrainConfig(**cfg_kw)
     model_cfg = get_model_config(variant)
     if model_overrides:
         model_cfg = dataclasses.replace(model_cfg, **model_overrides)
 
-    mesh, _ = _topology_mesh((1, 4, 1, 1, 1))
+    mesh, _ = _topology_mesh(mesh_shape)
     opt = make_optimizer(cfg)
     policy = get_dtype_policy(cfg)
     init_params, _, specs_fn, _ = get_model_api(model_cfg)
@@ -235,10 +251,10 @@ def _compile_train_step(variant, model_overrides, **cfg_overrides):
         lambda s, sh: _sds(s.shape, s.dtype, sh), shapes, shardings
     )
 
+    from fms_fsdp_tpu.parallel.mesh import data_parallel_extent
+
     step_fn = make_train_step(model_cfg, cfg, mesh, opt)
-    vocab = getattr(model_cfg, "src_vocab_size", None) or model_cfg.vocab_size
-    del vocab  # shapes only
-    gb = cfg.batch_size * mesh.devices.size
+    gb = cfg.batch_size * data_parallel_extent(mesh)
     bshape = (gb, cfg.seq_length)
     bsh = NamedSharding(mesh, resolve_spec(batch_pspec(), bshape, mesh))
     batch = (_sds(bshape, jnp.int32, bsh), _sds(bshape, jnp.int32, bsh))
@@ -293,6 +309,44 @@ TARGETS = [
             selective_checkpointing=1,
         ),
     ),
+    # multi-axis mesh plans on an 8-device 2-HOST v5e:2x4 topology: the
+    # dryrun_multichip compositions, compiled by the real TPU compiler
+    # with a host boundary in the device assignment (the CPU dryrun can
+    # only prove these shard; it cannot prove Mosaic+GSPMD compile them)
+    (
+        "train_llama_hsdp_tp_pallas_int8_2host",
+        lambda: _compile_train_step(
+            "llama2_7b",
+            {"nlayers": 2},
+            mesh_shape=(2, 2, 1, 1, 2),
+            sharding_strategy="hsdp",
+            sharding_group_size=2,
+            quantized_matmuls="int8_dgrad",
+            fsdp_activation_checkpointing=True,
+            selective_checkpointing=0.25,
+        ),
+    ),
+    (
+        "train_mamba_hybrid_cp_ring_2host",
+        lambda: _compile_train_step(
+            "mamba_9.8b",
+            {"n_layer": 2, "attn_layer_idx": (1,), "vocab_size": 32000},
+            mesh_shape=(1, 4, 1, 2, 1),
+            fsdp_activation_checkpointing=True,
+            selective_checkpointing=0.5,
+        ),
+    ),
+    (
+        "train_mixtral_ep_tp_int8_2host",
+        lambda: _compile_train_step(
+            "mixtral_8x7b",
+            {"nlayers": 1, "num_experts": 4, "capacity_factor": 1.25},
+            mesh_shape=(1, 2, 2, 1, 2),
+            quantized_matmuls="int8_dgrad",
+            fsdp_activation_checkpointing=True,
+            selective_checkpointing=1,
+        ),
+    ),
 ]
 
 
@@ -310,6 +364,8 @@ def _child(idx):
             "seconds": round(time.time() - t0, 1),
             "error": f"{type(e).__name__}: {e}"[:400],
         }
+    if _USED_TOPOLOGY:
+        r["topology"] = _USED_TOPOLOGY
     print("AOT_TARGET_JSON:" + json.dumps(r))
 
 
@@ -347,7 +403,10 @@ def main():
         results.append(r)
 
     out = {
-        "topology": TOPOLOGY,
+        "topology": (
+            f"default {TOPOLOGY}; multi-device targets may scale up — "
+            "see each entry's topology field"
+        ),
         "note": (
             "AOT lowering+compilation through the full XLA:TPU/Mosaic "
             "pipeline against a deviceless v5e TopologyDescription; "

@@ -9,7 +9,12 @@ Usage:
         --n_shards=4 --docs_per_shard=2500 --doc_len=1000 --vocab=4096
 """
 
+import os
 import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 from fms_fsdp_tpu.data.synth import build_arrow_corpus
 
