@@ -347,6 +347,22 @@ TARGETS = [
             selective_checkpointing=1,
         ),
     ),
+    # the 32k single-chip long-context bench row exactly as bench.py
+    # runs it: kv-streamed flash + full AC + chunked fused CE
+    (
+        "train_llama194m_32k_kvgrid_fusedce",
+        lambda: _compile_train_step(
+            "llama3_194m_4k",
+            {},
+            mesh_shape=(1, 1, 1, 1, 1),
+            batch_size=1,
+            seq_length=32768,
+            fused_loss=True,
+            flash_kernel_variant="kvgrid",
+            fsdp_activation_checkpointing=True,
+            selective_checkpointing=1,
+        ),
+    ),
 ]
 
 
