@@ -91,6 +91,27 @@ class TrainConfig:
     # continued training spec
     resuming_dataset: bool = False
 
+    # resilience (docs/resilience.md). Defaults are safe for production:
+    # skip non-finite updates, abort after a sustained bad streak, retry
+    # flaky shard reads, restart crashed loader workers, verify
+    # checkpoint manifests; the watchdog and fault injection are off.
+    anomaly_skip_updates: bool = True  # skip (don't apply) non-finite updates
+    anomaly_max_consecutive: int = 8  # abort after K consecutive bad steps
+    # Wall-clock hang watchdog; 0 disables. SIZING: the hot loop only
+    # dispatches steps asynchronously and blocks at the once-per-
+    # report_interval metric fetch, so a stuck collective is detected
+    # there — set this to cover a FULL report window of steps plus the
+    # first-step compile (e.g. 3 * report_interval * expected_step_time),
+    # NOT a single step's time. Checkpoint saves suspend the deadline
+    # (a healthy multi-minute Orbax save must not trip it).
+    step_timeout_s: float = 0.0
+    shard_read_retries: int = 3  # bounded retries per shard IO call
+    shard_read_backoff_s: float = 0.5  # initial backoff (doubles per retry)
+    loader_worker_restarts: int = 2  # worker restarts before the error surfaces
+    loader_restart_backoff_s: float = 1.0  # initial worker-restart backoff
+    checkpoint_verify: bool = True  # verify manifests on load, fall back on corruption
+    faults: str = ""  # fault-injection spec (testing only; see resilience/faults.py)
+
     # profiling
     use_profiler: bool = False
     profiler_rank0_only: bool = True

@@ -118,13 +118,33 @@ def _service_commands(pipeline, cmd) -> bool:
     return False
 
 
-def _process_worker_loop(pipeline, out_q, cmd, batch_size, produced):
+def _worker_fault(widx: int, produced_count: int):
+    """``loader_worker`` fault site, shared by both worker modes: fired
+    after each produced batch (filters: worker=, batch=). ``action=exit``
+    hard-kills the process (the OOM/preemption analog, process mode);
+    the default raises, exercising the forwarded-exception path."""
+    from fms_fsdp_tpu.resilience.faults import fire_fault
+
+    params = fire_fault("loader_worker", worker=widx, batch=produced_count)
+    if params is None:
+        return
+    if params.get("action") == "exit":
+        import os
+
+        os._exit(int(params.get("code", 3)))
+    raise RuntimeError(
+        f"injected loader worker crash (worker {widx}, "
+        f"batch {produced_count})"
+    )
+
+
+def _process_worker_loop(pipeline, out_q, cmd, batch_size, produced, widx=0):
     """One worker pipeline in a forked process: produce stacked batches
     into ``out_q``, service state commands from the parent at batch
     boundaries (the process-mode analog of thread mode's per-worker
     lock), and forward exceptions to the consumer. ``produced`` is a
     shared counter of batches built, read by the parent for save-skew
-    accounting."""
+    accounting (and continued across worker restarts)."""
     import signal
 
     try:
@@ -145,6 +165,7 @@ def _process_worker_loop(pipeline, out_q, cmd, batch_size, produced):
             batch = _stack(items)
             with produced.get_lock():
                 produced.value += 1
+            _worker_fault(widx, produced.value)
             while True:
                 if _service_commands(pipeline, cmd):
                     out_q.cancel_join_thread()
@@ -182,6 +203,13 @@ class StatefulDataLoader:
     owns an inflated rank and saves its own ``loader_state_<rank>`` file.
     """
 
+    # shutdown escalation budget (seconds): cooperative stop -> join ->
+    # SIGTERM -> join -> SIGKILL -> reap. Class attrs so tests (and
+    # latency-sensitive callers) can tighten the bounds.
+    STOP_JOIN_S = 5.0
+    TERM_JOIN_S = 2.0
+    KILL_JOIN_S = 2.0
+
     def __init__(
         self,
         dataset,
@@ -189,12 +217,19 @@ class StatefulDataLoader:
         num_workers: int = 1,
         prefetch_batches: int = 2,
         worker_mode: str = "thread",
+        max_worker_restarts: int = 2,
+        restart_backoff_s: float = 1.0,
     ):
         assert worker_mode in ("thread", "process"), worker_mode
         self.batch_size = batch_size
         self.num_workers = max(1, num_workers)
         self.prefetch_batches = max(1, prefetch_batches)
         self.worker_mode = worker_mode
+        # a worker that dies from a transient error is restarted with
+        # exponential backoff up to this many times (per worker, per
+        # iterator generation) before the error reaches the consumer
+        self.max_worker_restarts = max(0, max_worker_restarts)
+        self.restart_backoff_s = restart_backoff_s
         self._threads: List[threading.Thread] = []
         self._procs: list = []
         self._cmds: list = []
@@ -232,7 +267,7 @@ class StatefulDataLoader:
         return self.pipelines[0]
 
     @staticmethod
-    def _worker_loop(pipeline, out_q, lock, stop, batch_size, produced):
+    def _worker_loop(pipeline, out_q, lock, stop, batch_size, produced, widx=0):
         """Produce stacked batches from one worker pipeline into its queue.
         Exceptions are forwarded so the consumer re-raises them. The lock
         is held only while advancing the pipeline (never across the
@@ -248,6 +283,7 @@ class StatefulDataLoader:
                 with lock:
                     items = [next(it) for _ in range(batch_size)]
                     produced[0] += 1
+                _worker_fault(widx, produced[0])
                 batch = _stack(items)
                 while not stop.is_set():
                     try:
@@ -267,36 +303,53 @@ class StatefulDataLoader:
                     continue
 
     def shutdown(self):
-        """Stop worker threads/processes (idempotent). Call before
+        """Stop worker threads/processes (idempotent), within bounded
+        time. Escalation for a process worker that ignores the stop
+        command (wedged mid-batch, never reaches its command-servicing
+        boundary): cooperative stop -> join -> SIGTERM -> join -> SIGKILL
+        -> reap — the parent never hangs on a stuck worker. Call before
         inspecting pipeline state externally while an iterator is live."""
         self._stop.set()
         for t in self._threads:
-            t.join(timeout=5)
+            t.join(timeout=self.STOP_JOIN_S)
         self._threads = []
         for c in self._cmds:
+            if c is None:
+                continue
             try:
                 c.send(("stop", None))
             except (OSError, BrokenPipeError, ValueError):
                 pass
         for p in self._procs:
-            p.join(timeout=5)
+            if p is None:  # spawn loop interrupted mid-way
+                continue
+            p.join(timeout=self.STOP_JOIN_S)
             if p.is_alive():
                 p.terminate()
-                p.join(timeout=2)
+                p.join(timeout=self.TERM_JOIN_S)
                 if p.is_alive():
                     p.kill()
+                    # reap: SIGKILL is not ignorable, so this join only
+                    # waits out the kernel's teardown (bounded as a
+                    # belt-and-braces measure; a daemon zombie would
+                    # otherwise linger until interpreter exit)
+                    p.join(timeout=self.KILL_JOIN_S)
         self._procs, self._cmds = [], []
 
     def __del__(self):
         self._stop.set()  # reachable: worker threads don't reference self
         for c in getattr(self, "_cmds", []):
+            if c is None:
+                continue
             try:
                 c.send(("stop", None))
             except (OSError, BrokenPipeError, ValueError):
                 pass
 
     def _workers_alive(self) -> bool:
-        return bool(self._procs) and any(p.is_alive() for p in self._procs)
+        return bool(self._procs) and any(
+            p is not None and p.is_alive() for p in self._procs
+        )
 
     def _log_skew(self, op: str):
         """ADVICE r3: prefetching workers run ahead of consumption, so a
@@ -356,15 +409,16 @@ class StatefulDataLoader:
         self._threads = [
             threading.Thread(
                 target=self._worker_loop,
-                args=(p, q, lk, self._stop, self.batch_size, prod),
+                args=(p, q, lk, self._stop, self.batch_size, prod, i),
                 daemon=True,
             )
-            for p, q, lk, prod in zip(
-                self.pipelines, queues, self._locks, self._produced
+            for i, (p, q, lk, prod) in enumerate(
+                zip(self.pipelines, queues, self._locks, self._produced)
             )
         ]
         for t in self._threads:
             t.start()
+        restarts = [0] * self.num_workers
         w = 0
         while True:
             while True:
@@ -385,11 +439,51 @@ class StatefulDataLoader:
                 except queue.Empty:
                     continue
             if isinstance(batch, BaseException):
+                if self._can_restart(batch, restarts, w):
+                    # the pipeline object (and its position) lives in this
+                    # process: a restarted thread resumes the stream from
+                    # where the crashed one left it (minus the partial
+                    # batch in flight)
+                    t = threading.Thread(
+                        target=self._worker_loop,
+                        args=(
+                            self.pipelines[w],
+                            queues[w],
+                            self._locks[w],
+                            stop,
+                            self.batch_size,
+                            self._produced[w],
+                            w,
+                        ),
+                        daemon=True,
+                    )
+                    self._threads[w] = t
+                    t.start()
+                    continue
                 self.shutdown()
                 raise batch
             self._consumed[w] += 1
             yield batch
             w = (w + 1) % self.num_workers
+
+    def _can_restart(self, err, restarts, w) -> bool:
+        """Worker-restart budget check + backoff sleep. StopIteration
+        (stream genuinely ended) is never restarted; anything else gets
+        ``max_worker_restarts`` attempts per worker per generation with
+        exponential backoff before the error surfaces to the consumer."""
+        if isinstance(err, StopIteration):
+            return False
+        if restarts[w] >= self.max_worker_restarts:
+            return False
+        restarts[w] += 1
+        delay = self.restart_backoff_s * (2 ** (restarts[w] - 1))
+        print(
+            f"loader worker {w} died ({type(err).__name__}: {err}); "
+            f"restart {restarts[w]}/{self.max_worker_restarts} "
+            f"in {delay:.2f}s"
+        )
+        time.sleep(delay)
+        return True
 
     def _iter_process(self):
         """Process-mode consumer: forked worker processes (the reference's
@@ -441,20 +535,12 @@ class StatefulDataLoader:
         queues = [
             ctx.Queue(maxsize=self.prefetch_batches) for _ in self.pipelines
         ]
-        self._cmds = []
-        self._procs = []
-        for p, q, prod in zip(self.pipelines, queues, self._produced):
-            parent_conn, child_conn = ctx.Pipe()
-            proc = ctx.Process(
-                target=_process_worker_loop,
-                args=(p, q, child_conn, self.batch_size, prod),
-                daemon=True,
-            )
-            proc.start()
-            child_conn.close()
-            self._cmds.append(parent_conn)
-            self._procs.append(proc)
+        self._cmds = [None] * self.num_workers
+        self._procs = [None] * self.num_workers
+        for i in range(self.num_workers):
+            self._spawn_proc_worker(i, ctx, queues)
         procs = self._procs  # generation-local (shutdown() rebinds the attr)
+        restarts = [0] * self.num_workers
         w = 0
         while True:
             while True:
@@ -477,16 +563,64 @@ class StatefulDataLoader:
                         continue
                     if not procs[w].is_alive():
                         exitcode = procs[w].exitcode
-                        self.shutdown()
-                        raise RuntimeError(
+                        batch = RuntimeError(
                             f"loader worker {w} died (exit {exitcode})"
                         )
+                        break
             if isinstance(batch, BaseException):
+                if self._can_restart(batch, restarts, w):
+                    # refork from the parent's pipeline clone. The dead
+                    # worker's stream position died with it, so the
+                    # restarted worker resumes from the parent's last
+                    # captured state (construction or the last
+                    # load_from_path/re-iteration capture) — batches
+                    # consumed since then are REPLAYED; flag it.
+                    print(
+                        f"loader worker {w} restarting from the parent's "
+                        f"last captured pipeline state; batches consumed "
+                        f"since that capture will repeat"
+                    )
+                    # FRESH queue: a worker killed mid-put (SIGKILL/OOM)
+                    # can die holding the mp.Queue's shared write lock,
+                    # which would wedge the replacement worker's first
+                    # put forever. Prefetched batches in the old queue
+                    # are dropped — already covered by replay semantics.
+                    queues[w] = ctx.Queue(maxsize=self.prefetch_batches)
+                    self._spawn_proc_worker(w, ctx, queues)
+                    continue
                 self.shutdown()
                 raise batch
             self._consumed[w] += 1
             yield batch
             w = (w + 1) % self.num_workers
+
+    def _spawn_proc_worker(self, w, ctx, queues):
+        """(Re)fork worker ``w``: fresh pipe, fresh process over the
+        parent's pipeline clone, shared produced counter (so save-skew
+        accounting and batch-numbered fault filters survive restarts)."""
+        old = self._cmds[w]
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        parent_conn, child_conn = ctx.Pipe()
+        proc = ctx.Process(
+            target=_process_worker_loop,
+            args=(
+                self.pipelines[w],
+                queues[w],
+                child_conn,
+                self.batch_size,
+                self._produced[w],
+                w,
+            ),
+            daemon=True,
+        )
+        proc.start()
+        child_conn.close()
+        self._cmds[w] = parent_conn
+        self._procs[w] = proc
 
     # -- state (delegates to every worker pipeline) -----------------------
 
@@ -674,6 +808,16 @@ def get_data_loader(cfg, rank, world_size, postprocess=None, batch_multiplier=1)
         f"({list(_HANDLER_BUILDERS.keys())})"
     )
     filehandler = _HANDLER_BUILDERS[cfg.file_type](cfg)
+    # transient shard-read errors retry with bounded backoff; exhaustion
+    # surfaces OSError to StreamingDocDataset, which quarantines the
+    # shard instead of killing the run (resilience layer)
+    from fms_fsdp_tpu.resilience.retry import RetryingShardHandler
+
+    filehandler = RetryingShardHandler(
+        filehandler,
+        retries=max(0, getattr(cfg, "shard_read_retries", 3)),
+        backoff_s=getattr(cfg, "shard_read_backoff_s", 0.5),
+    )
 
     data = StreamingDocDataset(
         cfg.data_path,
@@ -738,6 +882,8 @@ def get_data_loader(cfg, rank, world_size, postprocess=None, batch_multiplier=1)
         batch_size=cfg.batch_size,
         num_workers=cfg.num_workers,
         worker_mode=getattr(cfg, "worker_mode", "thread"),
+        max_worker_restarts=getattr(cfg, "loader_worker_restarts", 2),
+        restart_backoff_s=getattr(cfg, "loader_restart_backoff_s", 1.0),
     )
 
 

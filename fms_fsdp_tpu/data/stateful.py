@@ -21,10 +21,13 @@ inside worker processes (ref:dataset_utils.py:108-119) happens at
 construction instead.
 """
 
+import logging
 import math
 import os
 import pickle
 from typing import Any, List
+
+logger = logging.getLogger(__name__)
 
 
 def shard_partition(itemlist: List[Any], rank: int, worldsize: int) -> List[Any]:
@@ -129,9 +132,33 @@ class StatefulDataset:
             state_dicts = shard_inclusive(state_dicts, self.rank, self.worldsize)
         if self.load_worldsize == self.worldsize:
             for flag in self.state_params + self.reshard_params:
-                setattr(self, flag, state_dicts[0][self.statename(flag)])
+                # keys absent from the checkpoint (state params added in
+                # a later version, e.g. quarantined_shards) keep their
+                # constructed defaults instead of failing the resume —
+                # but LOUDLY: a partial dict can also mean a torn loader
+                # state file, and a silently-defaulted position key would
+                # replay data with no trace
+                key = self.statename(flag)
+                if key in state_dicts[0]:
+                    setattr(self, flag, state_dicts[0][key])
+                else:
+                    logger.warning(
+                        "loader state for %s is missing key %r; keeping "
+                        "the constructed default (new-version state "
+                        "param, or a torn/partial checkpoint)",
+                        type(self).__name__,
+                        key,
+                    )
         else:
             for flag in self.reshard_params:
+                if self.statename(flag) not in state_dicts[0]:
+                    logger.warning(
+                        "loader state for %s is missing reshard key %r; "
+                        "keeping the constructed default",
+                        type(self).__name__,
+                        self.statename(flag),
+                    )
+                    continue
                 setattr(
                     self,
                     flag,

@@ -90,6 +90,15 @@ class StreamingDocDataset(StatefulDataset):
         self.docs_seen = 0
         self.percent_seen = 0
 
+        # shards whose reads kept failing after bounded retries: skipped
+        # (not fatal) and carried in the state_dict so a resume doesn't
+        # rediscover the same bad file the hard way. Shards unreadable at
+        # SETUP (length probe failed; zero-doc span for the whole run)
+        # are tracked separately so the epoch-boundary re-probe doesn't
+        # pointlessly clear them — setup() rebuilds that set on resume.
+        self.quarantined_shards: List[str] = []
+        self._setup_quarantined: Set[str] = set()
+
         self.state_params = [
             "dataset",
             "docset_index",
@@ -99,6 +108,7 @@ class StreamingDocDataset(StatefulDataset):
             "docs_seen",
             "percent_seen",
             "lcg_state",
+            "quarantined_shards",
         ]
 
         self.is_setup = False
@@ -137,10 +147,20 @@ class StreamingDocDataset(StatefulDataset):
                         key = fullpath[prefix + len(dataset) + 1 :]
                         doc_counts[key] = int(row["documents"])
             return doc_counts
-        return {
-            shard: self.filehandler.length(os.path.join(self.datapath, shard))
-            for shard in set(shard for shard, frag in shardfrags)
-        }
+        doc_counts = {}
+        for shard in set(shard for shard, frag in shardfrags):
+            try:
+                doc_counts[shard] = self.filehandler.length(
+                    os.path.join(self.datapath, shard)
+                )
+            except OSError as e:
+                # unreadable at setup (after the retry layer gave up):
+                # quarantine and contribute zero docs — the run starts on
+                # the readable shards instead of dying in setup
+                self._quarantine(shard, e)
+                self._setup_quarantined.add(shard)
+                doc_counts[shard] = 0
+        return doc_counts
 
     def setup(self):
         if self.is_setup:
@@ -250,6 +270,27 @@ class StreamingDocDataset(StatefulDataset):
             parts.append(np.array([self.eos], dtype=np.int64))
         return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
+    def _quarantine(self, shardid, err) -> None:
+        """Mark ``shardid`` unreadable: its reads kept failing after the
+        retry layer gave up. The shard's remaining docs are skipped (the
+        run survives); the set rides in the state_dict. If EVERY owned
+        shard is quarantined the stream would go silent — that is fatal."""
+        if shardid not in self.quarantined_shards:
+            self.quarantined_shards.append(shardid)
+            logger.error(
+                "Worker %d quarantining shard %s after exhausted retries "
+                "(%s); its remaining documents will be skipped",
+                self.rank,
+                shardid,
+                err,
+            )
+        owned = set(s for s, _, _ in self.docset)
+        if owned and owned.issubset(set(self.quarantined_shards)):
+            raise RuntimeError(
+                f"worker {self.rank}: all {len(owned)} owned shards are "
+                f"quarantined; no readable data remains"
+            ) from err
+
     def __iter__(self):
         if not self.is_setup:
             self.setup()
@@ -259,9 +300,43 @@ class StreamingDocDataset(StatefulDataset):
         # are replayed at the END of the epoch so the epoch stays exact
         residual_chunks = self.chunk_index + 1
         ndocs = self._len
+        if ndocs == 0:
+            raise RuntimeError(
+                f"worker {self.rank}: no readable documents in "
+                f"{self.datapath}"
+                + (
+                    f" ({len(self.quarantined_shards)} shard(s) "
+                    f"quarantined: {self.quarantined_shards})"
+                    if self.quarantined_shards
+                    else ""
+                )
+            )
         path = ""
         reader = None
+        first_pass = True
         while True:
+            # Epoch boundary (and resume start): re-probe quarantined
+            # shards. A transient storage outage outlasting the retry
+            # budget must not exclude data for the rest of a multi-week
+            # run — each new pass retries the shard once (one bounded
+            # retry cycle per epoch if it is still dead, after which it
+            # re-quarantines). Shards unreadable at SETUP contribute zero
+            # docs for the whole run (their docset spans are fixed); only
+            # iteration-time quarantine heals here.
+            if self.quarantined_shards and not first_pass:
+                logger.info(
+                    "Worker %d re-probing %d quarantined shard(s) at the "
+                    "epoch boundary: %s",
+                    self.rank,
+                    len(self.quarantined_shards),
+                    self.quarantined_shards,
+                )
+                self.quarantined_shards = [
+                    s
+                    for s in self.quarantined_shards
+                    if s in self._setup_quarantined
+                ]
+            first_pass = False
             for i in range(ndocs):
                 doc_index = (docset_offset + i) % ndocs
                 if doc_index == 0:
@@ -269,11 +344,22 @@ class StreamingDocDataset(StatefulDataset):
                 self.docset_index = doc_index
                 shardid, docrange, mindoc = self._get_docid(doc_index)
 
-                newpath = os.path.join(self.datapath, shardid)
-                path, reader = self._open_if_new(path, newpath, reader)
                 doclcg = self._random_map_docid(docrange)
+                if shardid in self.quarantined_shards:
+                    self.lcg_state = doclcg  # keep the walk deterministic
+                    continue
                 docid = doclcg + mindoc
-                doc = self.filehandler.get(reader, docid, self.drop)
+                try:
+                    newpath = os.path.join(self.datapath, shardid)
+                    path, reader = self._open_if_new(path, newpath, reader)
+                    doc = self.filehandler.get(reader, docid, self.drop)
+                except OSError as e:
+                    # retries exhausted inside the handler: quarantine the
+                    # shard and move on instead of killing the run
+                    path, reader = "", None
+                    self._quarantine(shardid, e)
+                    self.lcg_state = doclcg
+                    continue
                 if len(doc) == 0:
                     continue
                 doclen = len(doc) + 1 if self.bos is None else len(doc) + 2
@@ -298,9 +384,16 @@ class StreamingDocDataset(StatefulDataset):
             self.lcg_state = lcg_offset
             shardid, docrange, mindoc = self._get_docid(docset_offset)
             docid = self._random_map_docid(docrange) + mindoc
-            newpath = os.path.join(self.datapath, shardid)
-            path, reader = self._open_if_new(path, newpath, reader)
-            doc = self.filehandler.get(reader, docid, self.drop)
+            if shardid in self.quarantined_shards:
+                continue
+            try:
+                newpath = os.path.join(self.datapath, shardid)
+                path, reader = self._open_if_new(path, newpath, reader)
+                doc = self.filehandler.get(reader, docid, self.drop)
+            except OSError as e:
+                path, reader = "", None
+                self._quarantine(shardid, e)
+                continue
             if len(doc) == 0:
                 continue
             doclen = len(doc) + 1 if self.bos is None else len(doc) + 2

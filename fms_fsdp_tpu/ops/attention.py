@@ -82,7 +82,7 @@ def _flash_sharded(q, k, v, causal, mesh):
     compile with "Mosaic kernels cannot be automatically partitioned"
     (caught by scripts/aot_lower_kernels.py against a v5e topology — the
     CPU multichip dryruns resolve impl='auto' to XLA and never see it)."""
-    from jax import shard_map
+    from fms_fsdp_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from fms_fsdp_tpu.ops.pallas_mode import interpret_default

@@ -34,7 +34,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map  # jax >= 0.8 API (check_vma kwarg)
+from fms_fsdp_tpu.parallel.compat import shard_map  # >=0.8 surface on any jax
 from jax.sharding import PartitionSpec as P
 
 from fms_fsdp_tpu.ops.flash_attention import (

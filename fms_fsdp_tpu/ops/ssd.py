@@ -30,6 +30,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fms_fsdp_tpu.parallel.compat import tpu_compiler_params
+
 from fms_fsdp_tpu.ops.flash_attention import NEG_INF
 
 
@@ -198,7 +200,7 @@ def _ssd_core_pallas_fwd(x, dtf, a, Bm, Cm, L, interpret):
             pltpu.VMEM((L, L), jnp.float32),  # shared C@B^T per (b,g,chunk)
             pltpu.VMEM((R, N, P), jnp.float32),  # per-head carried state
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             # state/cb scratch carry across (chunk, head) — sequential;
             # batch/group cells are independent
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary")
@@ -331,7 +333,7 @@ def ssd_scan(
 
         interpret = interpret_default()
         if mesh is not None and mesh.size > 1:
-            from jax import shard_map
+            from fms_fsdp_tpu.parallel.compat import shard_map
             from jax.sharding import PartitionSpec as P_
 
             from fms_fsdp_tpu.parallel.mesh import AXIS_TENSOR, DATA_AXES
@@ -433,7 +435,7 @@ def ssd_scan_cp(
     ``ssd_scan`` but "pallas" does not apply here (and "auto" resolves
     to XLA on the single-device path too, by chip measurement).
     """
-    from jax import shard_map  # jax >= 0.8 API (check_vma kwarg)
+    from fms_fsdp_tpu.parallel.compat import shard_map  # >=0.8 surface on any jax
     from fms_fsdp_tpu.parallel.mesh import AXIS_CONTEXT, DATA_AXES
     from fms_fsdp_tpu.parallel.sharding import resolve_spec
     from jax.sharding import PartitionSpec as P

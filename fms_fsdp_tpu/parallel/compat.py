@@ -1,0 +1,62 @@
+"""JAX API compatibility shims.
+
+The kernels and MoE dispatch target the jax >= 0.8 surface
+(``jax.shard_map`` with ``check_vma`` / ``axis_names``); older
+environments (< 0.5) only ship ``jax.experimental.shard_map.shard_map``
+with ``check_rep`` / ``auto``. This module presents the new-style
+signature on either, so a version mismatch degrades to a shim instead of
+an ImportError that takes out every sharded kernel path (robustness:
+version skew between the pinned dev env and a site's jax install is a
+deployment fault, not a crash).
+"""
+
+try:  # jax >= 0.8: top-level export, check_vma kwarg
+    from jax import shard_map as _new_shard_map
+except ImportError:
+    _new_shard_map = None
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, axis_names=None):
+    """``jax.shard_map`` facade with the >= 0.8 keyword surface.
+
+    ``axis_names`` (new API: the axes the body is manual over; None =
+    all) maps onto the legacy ``auto`` complement; ``check_vma`` maps
+    onto legacy ``check_rep``.
+    """
+    if _new_shard_map is not None:
+        kwargs = dict(
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return _new_shard_map(f, **kwargs)
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _old_shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+        auto=auto,
+    )
+
+
+def has_new_shard_map() -> bool:
+    return _new_shard_map is not None
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across the jax >= 0.7 rename (older
+    releases call it ``TPUCompilerParams``; same dataclass fields)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:  # pre-rename jax
+        cls = pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -1,0 +1,47 @@
+"""Resilience layer: fault injection, anomaly guards, retrying shard IO,
+and checkpoint integrity.
+
+At pod scale the harness's job is mostly surviving: preempted hosts,
+flaky shard reads off GCS/NFS, torn checkpoints, and the occasional
+non-finite batch. The preemption half lives in
+``utils/train_utils.PreemptionGuard``; this package owns the rest:
+
+- ``faults``    — deterministic, env/config-driven fault injection at
+  named sites, so every guard below is testable on CPU
+  (``tests/test_resilience.py``);
+- ``guards``    — host-side anomaly accounting over the in-jit
+  non-finite flag (skip/report/abort) and a wall-clock step watchdog;
+- ``retry``     — bounded retry-with-backoff helpers and the retrying
+  shard-file handler wrapper;
+- ``integrity`` — per-checkpoint manifests (file list + sizes +
+  checksums of small metadata files) written at commit time and
+  verified on load.
+
+Recovery semantics are documented in docs/resilience.md.
+"""
+
+from fms_fsdp_tpu.resilience.faults import (
+    configure_faults,
+    fault_params,
+    fire_fault,
+    maybe_raise_fault,
+)
+from fms_fsdp_tpu.resilience.guards import AnomalyGuard, StepWatchdog
+from fms_fsdp_tpu.resilience.integrity import (
+    verify_manifest,
+    write_manifest,
+)
+from fms_fsdp_tpu.resilience.retry import RetryingShardHandler, retry_call
+
+__all__ = [
+    "AnomalyGuard",
+    "RetryingShardHandler",
+    "StepWatchdog",
+    "configure_faults",
+    "fault_params",
+    "fire_fault",
+    "maybe_raise_fault",
+    "retry_call",
+    "verify_manifest",
+    "write_manifest",
+]

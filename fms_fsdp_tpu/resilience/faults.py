@@ -1,0 +1,147 @@
+"""Deterministic fault injection at named sites.
+
+Every recovery path in the harness (shard-read retry/quarantine, loader
+worker restart, non-finite-batch skip/abort, checkpoint-corruption
+fallback) must be testable on CPU without real flaky storage or a real
+diverging model. This registry injects faults deterministically at the
+named sites the production code consults:
+
+==============  =======================================================
+site            fires where
+==============  =======================================================
+shard_read      RetryingShardHandler, before each delegated
+                open/length/get/slice call (raises OSError)
+loader_worker   StatefulDataLoader worker loops (thread + process),
+                after each produced batch (raises RuntimeError, or
+                hard-exits with ``action=exit``)
+nan_loss        inside the jitted train step (multiplies loss and grads
+                by NaN for the matching step window) — consulted once
+                at trace time via :func:`fault_params`
+ckpt_corrupt    Checkpointer.save, after the commit marker is written
+                (truncates one file inside the committed checkpoint)
+==============  =======================================================
+
+Spec strings configure the registry, via the ``FMS_FAULTS`` environment
+variable or ``TrainConfig.faults``::
+
+    site[:key=value]*  joined by ';'
+    e.g.  "shard_read:path=quartershard:times=2;nan_loss:step=5:count=3"
+
+Filter params are matched against the call-site context before firing:
+``path`` / ``op`` (substring), ``worker`` / ``batch`` / ``step``
+(equality). A configured filter the call site does not supply in its
+context is a non-match (the fault does not fire) — a typo'd filter must
+never degrade into firing everywhere.
+``times=N`` caps the number of fires (per process; counters are
+inherited across fork but not shared back). Everything else
+(``count``, ``action``, ``code``, ``file``) is payload the call site
+interprets. Production runs leave the registry empty: every hook is a
+dict lookup returning None.
+"""
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_LOCK = threading.Lock()
+# site -> params; None until first configure (lazy env read)
+_SPECS: Optional[Dict[str, Dict[str, Any]]] = None
+_FIRED: Dict[str, int] = {}
+
+ENV_VAR = "FMS_FAULTS"
+
+# params that filter whether a call-site context matches (vs payload)
+_FILTER_KEYS = ("path", "op", "worker", "batch", "step")
+
+
+def _parse_value(v: str):
+    try:
+        return int(v)
+    except ValueError:
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+
+def parse_spec(spec: str) -> Dict[str, Dict[str, Any]]:
+    """Parse ``site:key=val:key=val;site2:...`` into {site: params}."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":")
+        site, params = parts[0].strip(), {}
+        for kv in parts[1:]:
+            if not kv.strip():
+                continue
+            if "=" not in kv:
+                raise ValueError(
+                    f"fault clause {clause!r}: expected key=value, got {kv!r}"
+                )
+            k, v = kv.split("=", 1)
+            params[k.strip()] = _parse_value(v.strip())
+        out[site] = params
+    return out
+
+
+def configure_faults(spec: Optional[str]) -> None:
+    """(Re)configure the registry from a spec string; None or "" clears
+    it (and suppresses the lazy env read)."""
+    global _SPECS
+    with _LOCK:
+        _SPECS = parse_spec(spec) if spec else {}
+        _FIRED.clear()
+
+
+def _specs() -> Dict[str, Dict[str, Any]]:
+    global _SPECS
+    if _SPECS is None:
+        with _LOCK:
+            if _SPECS is None:
+                _SPECS = parse_spec(os.environ.get(ENV_VAR, ""))
+    return _SPECS
+
+
+def fault_params(site: str) -> Optional[Dict[str, Any]]:
+    """The raw configured params for ``site`` (no firing, no counters) —
+    for sites consulted once at build/trace time (``nan_loss``)."""
+    return _specs().get(site)
+
+
+def fire_fault(site: str, **ctx) -> Optional[Dict[str, Any]]:
+    """Fire ``site`` if configured and the context matches its filters.
+    Returns the params dict on fire (the call site interprets payload
+    keys), else None."""
+    params = _specs().get(site)
+    if params is None:
+        return None
+    for key in _FILTER_KEYS:
+        if key in params:
+            if key not in ctx:
+                # a configured filter the call site can't evaluate is a
+                # NON-match: firing everywhere because a filter didn't
+                # apply would be maximal injection from a typo
+                return None
+            want, got = params[key], ctx[key]
+            if isinstance(want, str):
+                if want not in str(got):
+                    return None
+            elif want != got:
+                return None
+    with _LOCK:
+        times = params.get("times")
+        if times is not None and _FIRED.get(site, 0) >= times:
+            return None
+        _FIRED[site] = _FIRED.get(site, 0) + 1
+    return params
+
+
+def maybe_raise_fault(site: str, exc_cls=OSError, **ctx) -> None:
+    """Fire ``site`` and raise ``exc_cls`` when it matches."""
+    params = fire_fault(site, **ctx)
+    if params is not None:
+        raise exc_cls(
+            f"injected fault at site {site!r} (ctx={ctx}, params={params})"
+        )

@@ -1,0 +1,132 @@
+"""Hot-loop anomaly guards: non-finite batch accounting and a wall-clock
+step watchdog.
+
+Detection happens INSIDE the jitted step (train/step.py computes a
+``metrics["nonfinite"]`` flag and skips the poisoned update on device),
+so the guard costs no extra host sync: the host only sees the flags at
+report time, when the metric window is fetched anyway. This module owns
+the host-side policy over those flags — count and report skipped
+batches, abort cleanly (with a final checkpoint) after K consecutive bad
+steps instead of silently diverging.
+
+The watchdog covers the opposite failure: a step that never finishes
+(stuck collective, wedged host). The trainer heartbeats it once per loop
+iteration; if no beat lands within the timeout it dumps all thread
+stacks and hard-exits nonzero, so the scheduler restarts the job instead
+of burning the reservation on a hang.
+"""
+
+import contextlib
+import faulthandler
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Iterable
+
+logger = logging.getLogger(__name__)
+
+
+class AnomalyGuard:
+    """Accumulates per-step non-finite flags fetched at report time.
+
+    ``observe`` consumes the flags in step order; ``should_abort``
+    becomes True once ``max_consecutive`` bad steps run back-to-back
+    (a poisoned data region or true divergence — skipping forever would
+    silently train on nothing). Isolated bad batches are just counted:
+    the update was already skipped on device.
+    """
+
+    def __init__(self, max_consecutive: int = 8):
+        assert max_consecutive > 0
+        self.max_consecutive = max_consecutive
+        self.skipped_batches = 0
+        self.consecutive = 0
+        self.worst_streak = 0
+
+    def observe(self, flags: Iterable[float]) -> int:
+        """Feed one report window's flags; returns the window's skip
+        count."""
+        window_skips = 0
+        for f in flags:
+            if f:
+                window_skips += 1
+                self.consecutive += 1
+                self.worst_streak = max(self.worst_streak, self.consecutive)
+            else:
+                self.consecutive = 0
+        self.skipped_batches += window_skips
+        return window_skips
+
+    def should_abort(self) -> bool:
+        return self.consecutive >= self.max_consecutive
+
+
+class StepWatchdog:
+    """Wall-clock watchdog over training progress.
+
+    ``beat()`` is called once per loop iteration (cheap: one monotonic
+    read + store). A daemon thread polls; if the gap since the last beat
+    exceeds ``timeout_s`` it dumps every thread's stack via faulthandler
+    (the post-mortem for "which collective wedged") and ``os._exit``\\ s
+    with :data:`EXIT_CODE` — a stuck collective must not hang forever.
+    """
+
+    EXIT_CODE = 2
+
+    def __init__(self, timeout_s: float, poll_s: float = None):
+        assert timeout_s > 0
+        self.timeout_s = timeout_s
+        self.poll_s = min(1.0, timeout_s / 4) if poll_s is None else poll_s
+        self._last_beat = time.monotonic()
+        self._paused = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self) -> "StepWatchdog":
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="step-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+
+    @contextlib.contextmanager
+    def paused(self):
+        """Suspend the deadline around a known-long healthy host
+        operation (a multi-minute Orbax save must not be judged by a
+        timeout sized for step windows). Re-arms with a fresh beat."""
+        self._paused += 1
+        try:
+            yield
+        finally:
+            # beat BEFORE unpausing: the poller must never observe
+            # paused==0 while _last_beat is still pre-pause stale
+            self.beat()
+            self._paused -= 1
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            if self._paused:
+                continue
+            stalled = time.monotonic() - self._last_beat
+            if stalled > self.timeout_s:
+                sys.stderr.write(
+                    f"step watchdog: no training progress for "
+                    f"{stalled:.1f}s (timeout {self.timeout_s}s); dumping "
+                    f"stacks and exiting {self.EXIT_CODE}\n"
+                )
+                sys.stderr.flush()
+                try:
+                    faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+                except Exception:  # noqa: BLE001 — already dying, exit anyway
+                    pass
+                sys.stderr.flush()
+                os._exit(self.EXIT_CODE)

@@ -306,7 +306,7 @@ def train_speculator(
                 print("overall speed:", elapsed_time / (batch_idx - start_step))
                 print("LR:", float(fetched[-1]["lr"]))
                 print(
-                    "overall token per gpu per sec:",
+                    "overall token per chip per sec:",
                     int(elapsed_tokens / world_size / elapsed_time),
                 )
                 print(

@@ -174,7 +174,18 @@ def make_train_step(
     """Build the jitted train step: (state, (input, label)) -> (state, metrics).
 
     metrics = {loss, gnorm (pre-clip global grad norm, the value the
-    reference logs, ref:train_utils.py:96,109), lr}.
+    reference logs, ref:train_utils.py:96,109), lr, nonfinite (1.0 when
+    the batch produced a non-finite loss or grad norm — the anomaly
+    guard's on-device flag, fetched with the rest of the window so the
+    host never syncs for it)}.
+
+    Anomaly guard (cfg.anomaly_skip_updates, default on): when the flag
+    is set the update is skipped on device — the clip scale collapses to
+    0 (zeroing the grads via the jnp.where select below) and params /
+    optimizer state carry the previous step's values forward, so one
+    poisoned batch can never write NaN into the moments. Host-side
+    policy over the flags (report skipped_batches, abort after K
+    consecutive) lives in resilience/guards.py.
 
     The LR is evaluated at ``state["step"] + start_step`` and injected into
     the optimizer each step; ``start_step`` is nonzero only when training
@@ -195,6 +206,13 @@ def make_train_step(
 
     fused = cfg.fused_loss
     chunk = cfg.loss_chunk_size
+
+    # resilience: skip-on-nonfinite guard + the nan_loss injection site
+    # (both resolved at trace time — no per-step host involvement)
+    from fms_fsdp_tpu.resilience.faults import fault_params
+
+    guard_updates = bool(getattr(cfg, "anomaly_skip_updates", True))
+    nan_fault = fault_params("nan_loss")
 
     from fms_fsdp_tpu.models import MambaConfig, MixtralConfig
 
@@ -257,13 +275,38 @@ def make_train_step(
         (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params_c, inputs, labels
         )
+        if nan_fault is not None:
+            # injected non-finite batch: poison loss AND grads for steps
+            # [step, step+count) — the NaN-batch failure the guard below
+            # must absorb (tests/test_resilience.py)
+            at = int(nan_fault.get("step", 0))
+            cnt = int(nan_fault.get("count", 1))
+            s = state["step"] + start_step
+            poison = jnp.where(
+                (s >= at) & (s < at + cnt), jnp.float32(jnp.nan), jnp.float32(1.0)
+            )
+            loss = loss * poison
+            grads = jax.tree.map(lambda g: g * poison.astype(g.dtype), grads)
         # Global-norm clip with the norm accumulated in fp32 regardless of
         # grad dtype — matches torch clip_grad_norm_ (ref:train_utils.py:96);
         # the pre-clip norm is the value the reference logs.
         gnorm = optax.global_norm(
             jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         )
+        # on-device anomaly flag: loss or grad norm went non-finite (the
+        # global norm folds every grad leaf, so one bad leaf trips it)
+        nonfinite = jnp.logical_not(
+            jnp.logical_and(jnp.isfinite(loss), jnp.isfinite(gnorm))
+        )
         clip_scale = jnp.minimum(1.0, cfg.grad_clip_thresh / (gnorm + 1e-6))
+        if guard_updates:
+            # zero poisoned grads with a true select — scaling by 0 would
+            # NOT clear NaN (0*NaN=NaN). Also select the clip scale sane:
+            # a NaN gnorm makes clip_scale NaN for every leaf otherwise.
+            clip_scale = jnp.where(nonfinite, jnp.float32(1.0), clip_scale)
+            grads = jax.tree.map(
+                lambda g: jnp.where(nonfinite, jnp.zeros_like(g), g), grads
+            )
         grads = jax.tree.map(lambda g: g * clip_scale.astype(g.dtype), grads)
         lr = schedule(state["step"])
         opt_state = state["opt_state"]._replace(
@@ -271,10 +314,26 @@ def make_train_step(
         )
         updates, opt_state = optimizer.update(grads, opt_state, state["params"])
         params = optax.apply_updates(state["params"], updates)
+        if guard_updates:
+            # fully skip the update: even zeroed grads decay Adam moments
+            # and apply weight decay — carry the old state forward. This
+            # restore is the actual correctness guarantee; the grad
+            # zeroing above only keeps the optimizer arithmetic finite.
+            params = jax.tree.map(
+                lambda new, old: jnp.where(nonfinite, old, new),
+                params,
+                state["params"],
+            )
+            opt_state = jax.tree.map(
+                lambda new, old: jnp.where(nonfinite, old, new),
+                opt_state,
+                state["opt_state"],
+            )
         metrics = {
             "loss": loss,
             "gnorm": gnorm,
             "lr": lr,
+            "nonfinite": nonfinite.astype(jnp.float32),
             **stats,
         }
         return (

@@ -78,11 +78,28 @@ def load_params_only(load_path: str, init_params_fn):
         }
 
     shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
-    target = {
-        "params": shapes["params"],
-        "opt_state": jax.tree.map(lambda _: ocp.PLACEHOLDER, shapes["opt_state"]),
-        "step": ocp.PLACEHOLDER,
-    }
+    placeholder = getattr(ocp, "PLACEHOLDER", None)
+    if placeholder is not None:
+        target = {
+            "params": shapes["params"],
+            "opt_state": jax.tree.map(
+                lambda _: placeholder, shapes["opt_state"]
+            ),
+            "step": placeholder,
+        }
+    else:
+        # older orbax has no placeholder leaves: degrade to restoring the
+        # full state (3x the IO, Adam moments materialized) rather than
+        # failing the conversion outright
+        import warnings
+
+        warnings.warn(
+            "this orbax version lacks ocp.PLACEHOLDER: load_params_only "
+            "falls back to restoring the full train state (reads ~3x the "
+            "bytes). Upgrade orbax-checkpoint for params-only IO.",
+            stacklevel=2,
+        )
+        target = shapes
     state_dir = os.path.join(load_path, "state")
     if not os.path.isdir(state_dir):
         # newest step dir holding a COMMITTED model checkpoint
@@ -134,10 +151,14 @@ class Checkpointer:
         rank: int = None,
         local_rank: int = 0,
         report_fn=None,
+        verify: bool = True,
     ):
         self.max_ckps = n_to_save
         self.rank = jax.process_index() if rank is None else rank
         self.local_rank = local_rank
+        # verify per-checkpoint manifests on load and fall back to the
+        # next-newest committed checkpoint on corruption (resilience layer)
+        self.verify = verify
         self.ckp_path = os.path.join(ckpdir, "checkpoints/")
         os.makedirs(self.ckp_path, exist_ok=True)
         assert parallel_mode in ["fsdp", "hsdp", "ddp", "tp"]
@@ -160,38 +181,89 @@ class Checkpointer:
 
     # -- path resolution ----------------------------------------------------
 
-    def _validate_ckp_path(self, path):
-        """Resolve to a loadable checkpoint (file, step dir, or newest step
-        dir inside a checkpoint folder), else None."""
+    def _candidate_ckp_paths(self, path):
+        """All loadable checkpoints under ``path``, newest first: a file
+        or committed step dir resolves to itself; a checkpoint folder
+        resolves to its committed step entries ordered by step number.
+        The fallback chain for corrupt-restore recovery walks this list."""
         if not path or not os.path.exists(path):
-            return None
+            return []
         if os.path.isfile(path):
-            return path
+            return [path]
         entries = os.listdir(path)
         if "metadata.json" in entries:
-            return path
-        if len(entries) > 0:
-            # only step_<N>_ckp entries qualify (by step number, not
-            # ctime): foreign files parked in the folder must not shadow
-            # real checkpoints. Scan newest-first for a dir that actually
-            # holds MODEL state — the folder interleaves loader auto-save
-            # dirs (loader_state only, no metadata.json) with model
-            # checkpoints, and the newest step dir may be loader-only.
-            candidates = sorted(
-                (
-                    os.path.join(path, x)
-                    for x in entries
-                    if is_step_ckp(os.path.join(path, x))
-                ),
-                key=step_number,
-                reverse=True,
+            return [path]
+        # only step_<N>_ckp entries qualify (by step number, not
+        # ctime): foreign files parked in the folder must not shadow
+        # real checkpoints. Keep entries that actually hold MODEL
+        # state — the folder interleaves loader auto-save dirs
+        # (loader_state only, no metadata.json) with model checkpoints.
+        candidates = sorted(
+            (
+                os.path.join(path, x)
+                for x in entries
+                if is_step_ckp(os.path.join(path, x))
+            ),
+            key=step_number,
+            reverse=True,
+        )
+        return [
+            cand
+            for cand in candidates
+            if os.path.isfile(cand) or "metadata.json" in safe_listdir(cand)
+        ]
+
+    def _validate_ckp_path(self, path):
+        """Resolve to the newest loadable checkpoint (file, step dir, or
+        newest step dir inside a checkpoint folder), else None."""
+        candidates = self._candidate_ckp_paths(path)
+        return candidates[0] if candidates else None
+
+    def _broadcast_obj(self, obj):
+        """Broadcast a small JSON-able object from process 0 to all.
+        Two collectives: the byte length (fixed shape), then the padded
+        payload buffer (now same shape everywhere)."""
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        source = jax.process_index() == 0
+        # non-source processes contribute explicit zeros: some
+        # implementations of the broadcast reduce contributions, and
+        # only the source's bytes may survive the reduction
+        data = (
+            np.frombuffer(json.dumps(obj).encode("utf-8"), np.uint8)
+            if source
+            else np.zeros(0, np.uint8)
+        )
+        n = int(
+            multihost_utils.broadcast_one_to_all(
+                np.asarray(len(data), np.int32)
             )
-            for cand in candidates:
-                if os.path.isfile(cand):
-                    return cand
-                if "metadata.json" in safe_listdir(cand):
-                    return cand
-        return None
+        )
+        buf = np.zeros(n, np.uint8)
+        if source:
+            buf[:] = data
+        out = multihost_utils.broadcast_one_to_all(buf)
+        # some jax versions return the buffer upcast (uint8 -> int32):
+        # cast back before reassembling the bytes
+        out = np.asarray(out).astype(np.uint8)
+        return json.loads(out.tobytes().decode("utf-8"))
+
+    def _all_agree(self, ok: bool) -> bool:
+        """Collective AND of a per-process verdict. Fallback decisions
+        must be identical on every process — the Orbax restore is
+        collective, so two hosts restoring different candidates would
+        deadlock the pod (or assemble a mixed-step state). Single-process
+        worlds return the local verdict untouched."""
+        if jax.process_count() == 1:
+            return ok
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        votes = multihost_utils.process_allgather(
+            np.array([1 if ok else 0], np.int32)
+        )
+        return bool(np.asarray(votes).min() == 1)
 
     # -- cleanup ------------------------------------------------------------
 
@@ -254,15 +326,17 @@ class Checkpointer:
             reverse=True,
         )
         def newest_mtime(p):
-            # newest mtime across the dir and its files: a growing
-            # loader_state file bumps its own mtime, not the directory's
+            # mtime fingerprint across the dir and its files: a growing
+            # loader_state file bumps its own mtime, not the directory's.
+            # A full fingerprint (not max): a skewed writer can stamp a
+            # file BELOW the directory mtime, which a max would never see
             try:
-                return max(
-                    [os.path.getmtime(p)]
-                    + [
-                        os.path.getmtime(os.path.join(p, f))
+                return tuple(
+                    [("", os.path.getmtime(p))]
+                    + sorted(
+                        (f, os.path.getmtime(os.path.join(p, f)))
                         for f in safe_listdir(p)
-                    ]
+                    )
                 )
             except OSError:
                 return None
@@ -302,7 +376,14 @@ class Checkpointer:
     def save(self, step, state, dataloader=None, **metadata):
         """Write the sharded train state + loader state + metadata to
         ``step_<step>_ckp``. ``metadata`` kwargs (e.g. tokens_seen) land in
-        metadata.json with the step count."""
+        metadata.json with the step count.
+
+        Commit ordering: state shards -> loader state -> manifest ->
+        metadata.json (the commit marker, atomic rename). A save torn
+        before the marker leaves an uncommitted dir every scanner skips;
+        a committed checkpoint always has a verifiable manifest."""
+        from fms_fsdp_tpu.resilience.integrity import write_manifest
+
         save_time = time.time()
         save_name = os.path.join(self.ckp_path, f"step_{step}_ckp")
         os.makedirs(save_name, exist_ok=True)
@@ -314,14 +395,46 @@ class Checkpointer:
         if dataloader is not None:
             dataloader.save_to_path(save_name)
         if self.rank == 0:
+            write_manifest(save_name)
             metadata["step"] = step
-            with open(os.path.join(save_name, "metadata.json"), "w") as f:
+            meta_path = os.path.join(save_name, "metadata.json")
+            with open(meta_path + ".tmp", "w") as f:
                 json.dump(metadata, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(meta_path + ".tmp", meta_path)
+            self._maybe_corrupt(save_name, step)
         self.report(
             f"Checkpoint saved in {save_name}",
             model_save_time=time.time() - save_time,
         )
         return self._cleanup()
+
+    @staticmethod
+    def _maybe_corrupt(save_name, step):
+        """``ckpt_corrupt`` fault site: truncate one file inside the
+        just-committed checkpoint (``file=<substring>`` selects it) —
+        the torn/bit-rotted storage failure the load-time manifest
+        verification and fallback chain must absorb."""
+        from fms_fsdp_tpu.resilience.faults import fire_fault
+
+        params = fire_fault("ckpt_corrupt", step=step)
+        if params is None:
+            return
+        want = str(params.get("file", ""))
+        victims = []
+        for root, _, files in os.walk(save_name):
+            for name in files:
+                full = os.path.join(root, name)
+                if want in full and os.path.getsize(full) > 0:
+                    victims.append(full)
+        victims.sort()
+        assert victims, f"ckpt_corrupt: no file matching {want!r} in {save_name}"
+        victim = victims[0]
+        size = os.path.getsize(victim)
+        with open(victim, "rb+") as f:
+            f.truncate(size // 2)
+        print(f"ckpt_corrupt fault: truncated {victim} ({size} -> {size // 2})")
 
     # -- load ---------------------------------------------------------------
 
@@ -339,71 +452,175 @@ class Checkpointer:
         ``state`` is the freshly initialized sharded train state — it
         provides the target structure/sharding for restoration. Returns
         (state, dataloader, step, tokens_seen, is_resuming).
-        """
+
+        Integrity: each candidate checkpoint is manifest-verified (when
+        ``self.verify``) and its restore wrapped — a corrupt or torn
+        newest checkpoint falls back to the next-newest committed one
+        with a warning instead of killing the restart. Only when every
+        candidate fails does load raise (restarting a long run from
+        scratch silently would be worse than crashing)."""
+        from fms_fsdp_tpu.resilience.integrity import verify_manifest
+
         is_resuming = False
-        if self._validate_ckp_path(self.ckp_path) is not None:
+        candidates = self._candidate_ckp_paths(self.ckp_path)
+        if candidates:
             path = self.ckp_path
             is_resuming = True
-        load_path = self._validate_ckp_path(path)
-        if load_path is None:
+        else:
+            candidates = self._candidate_ckp_paths(path)
+        if jax.process_count() > 1:
+            # process 0's directory scan is authoritative: eventually-
+            # consistent shared storage can show hosts different listings,
+            # and every host must walk the SAME candidate list in the same
+            # order — the per-candidate votes and collective restores
+            # below are counted in lockstep
+            decision = self._broadcast_obj(
+                {"resume": is_resuming, "cands": candidates}
+            )
+            is_resuming = bool(decision["resume"])
+            candidates = [str(c) for c in decision["cands"]]
+        if not candidates:
             self.report(
                 f"No valid checkpoint detected at {path}, starting from scratch."
             )
             return state, dataloader, 0, 0, False
 
-        self.report(f"Prior checkpoint {load_path} detected.")
-        t0 = time.time()
-        if os.path.isfile(load_path):
-            # single-file checkpoint: bare model params (ddp/speculator
-            # path, ref:checkpointing_utils.py:215-233); optimizer and
-            # dataloader start fresh
-            with open(load_path, "rb") as f:
-                payload = pickle.load(f)
-            params = payload.get("model_state", payload)
-            target = state["params"]
-            merged = _merge_trees(target, params, strict)
-            shardings = jax.tree.map(lambda a: a.sharding, target)
-            loaded = jax.tree.map(
-                lambda arr, s: jax.device_put(arr, s), merged, shardings
-            )
-            state = dict(state, params=loaded)
-            self.report(
-                f"Checkpoint {load_path} is a single-file checkpoint "
-                "containing only a model. Optimizer and dataloader are "
-                "from scratch.",
-                model_load_time=time.time() - t0,
-            )
-            return state, dataloader, 0, 0, is_resuming
-
-        # sharded directory checkpoint: restore into the target sharding
-        abstract = jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
-            state,
-        )
-        state = self._ckptr.restore(os.path.join(load_path, "state"), abstract)
-        self.report(model_load_time=time.time() - t0)
-
-        step, ntok = 0, 0
-        if is_resuming and not reset_stepcount:
-            with open(os.path.join(load_path, "metadata.json")) as f:
-                meta = json.load(f)
-            step = meta.get("step", 0)
-            ntok = meta.get("tokens_seen", 0)
-            self.report("Metadata loaded", start_step=step, n_tokens_seen=ntok)
-        else:
-            # Continued pretraining from an external checkpoint: keep the
-            # optimizer moments but restart the schedule clock — the step
-            # counter drives the injected LR (ref:main_training_llama.py:
-            # 130-134 resets initial_lr + scheduler on non-resume loads).
-            if "step" in state:
-                state = dict(
-                    state, step=jax.tree.map(lambda s: s * 0, state["step"])
+        last_err = None
+        for load_path in candidates:
+            self.report(f"Prior checkpoint {load_path} detected.")
+            t0 = time.time()
+            if os.path.isfile(load_path):
+                # single-file checkpoint: bare model params (ddp/speculator
+                # path, ref:checkpointing_utils.py:215-233); optimizer and
+                # dataloader start fresh
+                err = None
+                payload = None
+                try:
+                    with open(load_path, "rb") as f:
+                        payload = pickle.load(f)
+                except (OSError, pickle.UnpicklingError, EOFError) as e:
+                    err = e
+                # every process must take the same branch: a host whose
+                # local read failed while a peer's succeeded would leave
+                # the pod on different checkpoints
+                if not self._all_agree(err is None):
+                    self.report(
+                        f"WARNING: single-file checkpoint {load_path} is "
+                        f"unreadable on at least one process ({err}); "
+                        f"falling back to the next-newest checkpoint."
+                    )
+                    last_err = err or RuntimeError(
+                        f"peer process failed to read {load_path}"
+                    )
+                    continue
+                params = payload.get("model_state", payload)
+                target = state["params"]
+                merged = _merge_trees(target, params, strict)
+                shardings = jax.tree.map(lambda a: a.sharding, target)
+                loaded = jax.tree.map(
+                    lambda arr, s: jax.device_put(arr, s), merged, shardings
                 )
+                state = dict(state, params=loaded)
+                self.report(
+                    f"Checkpoint {load_path} is a single-file checkpoint "
+                    "containing only a model. Optimizer and dataloader are "
+                    "from scratch.",
+                    model_load_time=time.time() - t0,
+                )
+                return state, dataloader, 0, 0, is_resuming
 
-        if dataloader is not None:
-            t1 = time.time()
-            dataloader.load_from_path(load_path)
-            self.report(dataset_load_time=time.time() - t1)
-        else:
-            self.report("Skipping dataset load, no dataloader provided.")
-        return state, dataloader, step, ntok, is_resuming
+            if self.verify:
+                ok, problems = verify_manifest(load_path)
+                # collective verdict: the restore below is a collective
+                # op, so a candidate one process rejects must be rejected
+                # by ALL of them (shared storage normally agrees; a
+                # host-local read error must not split the decision)
+                if not self._all_agree(ok):
+                    self.report(
+                        f"WARNING: checkpoint {load_path} failed integrity "
+                        f"verification on at least one process "
+                        f"({'; '.join(problems[:3]) or 'peer report'}); "
+                        f"falling back to the next-newest committed "
+                        f"checkpoint."
+                    )
+                    last_err = RuntimeError(
+                        f"integrity verification failed: {problems}"
+                    )
+                    continue
+                if problems:  # legacy pre-manifest checkpoint
+                    self.report(f"Note: {problems[0]}")
+
+            # sharded directory checkpoint: restore into the target sharding
+            abstract = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype, sharding=a.sharding
+                ),
+                state,
+            )
+            try:
+                restored = self._ckptr.restore(
+                    os.path.join(load_path, "state"), abstract
+                )
+                meta = None
+                if is_resuming and not reset_stepcount:
+                    # read metadata inside the fallback scope: a torn
+                    # metadata.json is a corrupt checkpoint too
+                    with open(os.path.join(load_path, "metadata.json")) as f:
+                        meta = json.load(f)
+                if dataloader is not None:
+                    # loader state is per-rank and excluded from the
+                    # manifest (another host may still be writing its
+                    # own), so a torn loader file surfaces HERE — it must
+                    # fall back with the rest of the checkpoint, not kill
+                    # the restart after a successful model restore
+                    t1 = time.time()
+                    dataloader.load_from_path(load_path)
+                    self.report(dataset_load_time=time.time() - t1)
+                else:
+                    self.report("Skipping dataset load, no dataloader provided.")
+            except Exception as e:  # noqa: BLE001 — any restore failure
+                # falls back to the next-newest committed checkpoint
+                if jax.process_count() > 1:
+                    # a failure thrown on THIS process mid-collective
+                    # cannot be recovered unilaterally: peers may be
+                    # parked inside the collective restore, and quietly
+                    # moving to an older candidate would deadlock or
+                    # mix steps across hosts. Fail loudly; the restart
+                    # supervisor retries the whole job.
+                    raise RuntimeError(
+                        f"restore from {load_path} failed on process "
+                        f"{self.rank}; multi-host fallback cannot proceed "
+                        f"safely from inside a failed collective restore"
+                    ) from e
+                self.report(
+                    f"WARNING: restore from {load_path} failed ({e!r}); "
+                    f"falling back to the next-newest committed checkpoint."
+                )
+                last_err = e
+                continue
+            state = restored
+            self.report(model_load_time=time.time() - t0)
+
+            step, ntok = 0, 0
+            if meta is not None:
+                step = meta.get("step", 0)
+                ntok = meta.get("tokens_seen", 0)
+                self.report(
+                    "Metadata loaded", start_step=step, n_tokens_seen=ntok
+                )
+            else:
+                # Continued pretraining from an external checkpoint: keep the
+                # optimizer moments but restart the schedule clock — the step
+                # counter drives the injected LR (ref:main_training_llama.py:
+                # 130-134 resets initial_lr + scheduler on non-resume loads).
+                if "step" in state:
+                    state = dict(
+                        state, step=jax.tree.map(lambda s: s * 0, state["step"])
+                    )
+
+            return state, dataloader, step, ntok, is_resuming
+
+        raise RuntimeError(
+            f"all {len(candidates)} checkpoint(s) under {path} failed to "
+            f"load; refusing to silently restart from scratch"
+        ) from last_err

@@ -17,6 +17,7 @@ cadence, resume semantics. TPU differences:
 import os
 import signal
 import time
+from contextlib import nullcontext as _nullctx
 from dataclasses import asdict
 
 import jax
@@ -251,8 +252,16 @@ def train(
     checkpointer,
     start_step,
     tokens_seen,
+    dataloader=None,
 ):
-    """Run the hot loop to cfg.num_steps. Returns the final reported loss."""
+    """Run the hot loop to cfg.num_steps. Returns the final reported loss.
+
+    ``dataloader`` is the stateful loader behind ``train_loader`` (which
+    is typically a rebatch/DeviceFeed iterator over it): when provided,
+    interval/final/preemption checkpoints persist the live loader state
+    into the same ``step_N_ckp`` dir as the model, so a resume continues
+    the data stream instead of relying on the loader's own auto-save
+    clock (which can drift from trainer steps)."""
     tracker_fn = get_tracker(cfg, rank)
 
     world_size = (
@@ -274,6 +283,7 @@ def train(
             tokens_seen,
             tracker_fn,
             world_size,
+            dataloader,
         )
     finally:
         if profiler:
@@ -293,109 +303,168 @@ def _train_loop(
     tokens_seen,
     tracker_fn,
     world_size,
+    dataloader=None,
 ):
+    from fms_fsdp_tpu.resilience.guards import AnomalyGuard, StepWatchdog
+
     window = []
     train_loss = -1.0
     start = time.time()
     loop_start = time.time()
     new_tokens_seen = 0
     preemption = PreemptionGuard().install()
+    guard = AnomalyGuard(
+        max_consecutive=max(1, getattr(cfg, "anomaly_max_consecutive", 8))
+    )
+    watchdog = None
+    timeout_s = float(getattr(cfg, "step_timeout_s", 0.0) or 0.0)
+    if timeout_s > 0:
+        watchdog = StepWatchdog(timeout_s).start()
 
-    for batch_idx, batch in enumerate(train_loader, start=start_step + 1):
-        if batch_idx > cfg.num_steps:
-            break
-        state, metrics = step_fn(state, batch)
-        window.append(metrics)
+    try:
+        for batch_idx, batch in enumerate(train_loader, start=start_step + 1):
+            if batch_idx > cfg.num_steps:
+                break
+            if watchdog:
+                watchdog.beat()
+            state, metrics = step_fn(state, batch)
+            window.append(metrics)
 
-        if profiler:
-            profiler.step()
+            if profiler:
+                profiler.step()
 
-        if batch_idx % cfg.report_interval == 0:
-            # one host sync per report interval
-            fetched = jax.device_get(window)
-            window = []
-            train_loss = float(
-                sum(m["loss"] for m in fetched) / max(1, len(fetched))
-            )
-            g_norm = float(sum(m["gnorm"] for m in fetched) / max(1, len(fetched)))
-            current_lr = float(fetched[-1]["lr"])
-            # any extra model-family metrics (e.g. MoE moe_drop_frac)
-            extra_metrics = {
-                k: float(sum(m[k] for m in fetched) / max(1, len(fetched)))
-                for k in fetched[-1]
-                if k not in ("loss", "gnorm", "lr")
-            }
-            elapsed_time = time.time() - loop_start
-            new_tokens_seen = (
-                (batch_idx - start_step)
-                * world_size
-                * cfg.batch_size
-                * cfg.seq_length
-            )
-            if rank == 0:
-                total_tokens_seen = tokens_seen + new_tokens_seen
-                current_step_time = (time.time() - start) / cfg.report_interval
-                overall_step_time = elapsed_time / (batch_idx - start_step)
-                current_throughput = int(
-                    cfg.batch_size * cfg.seq_length / current_step_time
+            if batch_idx % cfg.report_interval == 0:
+                # one host sync per report interval. This device_get is
+                # where a stuck collective actually manifests (the loop
+                # above only dispatches), so the watchdog timeout must
+                # cover a FULL report window of steps — see the
+                # step_timeout_s sizing note in config/training.py.
+                fetched = jax.device_get(window)
+                if watchdog:
+                    watchdog.beat()
+                window = []
+                # anomaly accounting: per-step non-finite flags in step
+                # order (updates for flagged steps were already skipped
+                # on device); report means over the clean steps only so
+                # one NaN doesn't poison the whole window's loss
+                flags = [float(m.pop("nonfinite", 0.0)) for m in fetched]
+                guard.observe(flags)
+                good = [m for m, f in zip(fetched, flags) if not f] or fetched
+                train_loss = float(
+                    sum(m["loss"] for m in good) / max(1, len(good))
                 )
-                overall_throughput = int(
-                    cfg.batch_size * cfg.seq_length / overall_step_time
+                g_norm = float(
+                    sum(m["gnorm"] for m in good) / max(1, len(good))
                 )
-                reserved_mem, allocated_mem = _memory_stats()
-
-                print("step:", batch_idx)
-                print("loss:", train_loss)
-                print("LR:", current_lr)
-                print("tokens seen:", total_tokens_seen)
-                print("gradient norm:", g_norm)
-                print("reserved memory:", reserved_mem)
-                print("allocated memory:", allocated_mem)
-                print("current step time:", current_step_time)
-                print("overall step time:", overall_step_time)
-                print("current token per gpu per sec:", current_throughput)
-                print("overall token per gpu per sec:", overall_throughput)
-                print(
-                    "overall token per day:",
-                    int(new_tokens_seen / elapsed_time * 3600 * 24),
+                current_lr = float(fetched[-1]["lr"])
+                # any extra model-family metrics (e.g. MoE moe_drop_frac)
+                extra_metrics = {
+                    k: float(sum(m[k] for m in good) / max(1, len(good)))
+                    for k in good[-1]
+                    if k not in ("loss", "gnorm", "lr")
+                }
+                elapsed_time = time.time() - loop_start
+                new_tokens_seen = (
+                    (batch_idx - start_step)
+                    * world_size
+                    * cfg.batch_size
+                    * cfg.seq_length
                 )
-                for k, v in extra_metrics.items():
-                    print(f"{k}:", v)
-                if tracker_fn:
-                    tracker_fn(
-                        {
-                            "learning rate": current_lr,
-                            "loss": train_loss,
-                            "gradient norm": g_norm,
-                            "token seen": total_tokens_seen,
-                            "current throughput (token per gpu per sec)": current_throughput,
-                            "overall throughput (token per gpu per sec)": overall_throughput,
-                            "gpu reserved memory": reserved_mem,
-                            "gpu allocated memory": allocated_mem,
-                            **extra_metrics,
-                        },
-                        step=batch_idx,
+                if rank == 0:
+                    total_tokens_seen = tokens_seen + new_tokens_seen
+                    current_step_time = (
+                        time.time() - start
+                    ) / cfg.report_interval
+                    overall_step_time = elapsed_time / (batch_idx - start_step)
+                    current_throughput = int(
+                        cfg.batch_size * cfg.seq_length / current_step_time
                     )
-            start = time.time()
+                    overall_throughput = int(
+                        cfg.batch_size * cfg.seq_length / overall_step_time
+                    )
+                    reserved_mem, allocated_mem = _memory_stats()
 
-        preempt_now = preemption.poll()
-        if (
-            batch_idx % cfg.checkpoint_interval == 0
-            or batch_idx == cfg.num_steps
-            or preempt_now
-        ):
-            checkpointer.save(
-                batch_idx,
-                state,
-                None,
-                tokens_seen=tokens_seen + new_tokens_seen,
-            )
-        if preempt_now:
-            if rank == 0:
-                print(
-                    f"preemption signal received: checkpoint saved at step "
-                    f"{batch_idx}, exiting clean"
-                )
-            break
+                    print("step:", batch_idx)
+                    print("loss:", train_loss)
+                    print("LR:", current_lr)
+                    print("tokens seen:", total_tokens_seen)
+                    print("gradient norm:", g_norm)
+                    print("reserved memory:", reserved_mem)
+                    print("allocated memory:", allocated_mem)
+                    print("current step time:", current_step_time)
+                    print("overall step time:", overall_step_time)
+                    print("current token per chip per sec:", current_throughput)
+                    print("overall token per chip per sec:", overall_throughput)
+                    print(
+                        "overall token per day:",
+                        int(new_tokens_seen / elapsed_time * 3600 * 24),
+                    )
+                    if guard.skipped_batches:
+                        print("skipped batches:", guard.skipped_batches)
+                    for k, v in extra_metrics.items():
+                        print(f"{k}:", v)
+                    if tracker_fn:
+                        tracker_fn(
+                            {
+                                "learning rate": current_lr,
+                                "loss": train_loss,
+                                "gradient norm": g_norm,
+                                "token seen": total_tokens_seen,
+                                "current throughput (token per chip per sec)": current_throughput,
+                                "overall throughput (token per chip per sec)": overall_throughput,
+                                "chip reserved memory": reserved_mem,
+                                "chip allocated memory": allocated_mem,
+                                "skipped batches": guard.skipped_batches,
+                                **extra_metrics,
+                            },
+                            step=batch_idx,
+                        )
+                start = time.time()
+
+                if guard.should_abort():
+                    # a poisoned data region or true divergence: skipping
+                    # forever would silently train on nothing. Save a
+                    # final checkpoint (params are the last good ones —
+                    # flagged updates never landed) and abort loudly.
+                    with watchdog.paused() if watchdog else _nullctx():
+                        checkpointer.save(
+                            batch_idx,
+                            state,
+                            dataloader,
+                            tokens_seen=tokens_seen + new_tokens_seen,
+                        )
+                    raise RuntimeError(
+                        f"anomaly guard: {guard.consecutive} consecutive "
+                        f"non-finite steps (threshold "
+                        f"{guard.max_consecutive}); checkpoint saved at "
+                        f"step {batch_idx}, aborting"
+                    )
+
+            preempt_now = preemption.poll()
+            if (
+                batch_idx % cfg.checkpoint_interval == 0
+                or batch_idx == cfg.num_steps
+                or preempt_now
+            ):
+                # the watchdog deadline is sized for step windows; a
+                # healthy multi-minute Orbax save must not trip it, so
+                # the watchdog is suspended (and re-armed) around it
+                with watchdog.paused() if watchdog else _nullctx():
+                    checkpointer.save(
+                        batch_idx,
+                        state,
+                        dataloader,
+                        tokens_seen=tokens_seen + new_tokens_seen,
+                    )
+            if preempt_now:
+                if rank == 0:
+                    print(
+                        f"preemption signal received: checkpoint saved at "
+                        f"step {batch_idx}, exiting clean"
+                    )
+                break
+    finally:
+        if watchdog:
+            watchdog.stop()
 
     return train_loss

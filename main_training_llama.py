@@ -44,6 +44,13 @@ def main(**kwargs):
     cfg = TrainConfig()
     update_config(cfg, **kwargs)
 
+    if cfg.faults:
+        # fault-injection spec from config (tests); the FMS_FAULTS env
+        # var is read lazily by the registry when this is empty
+        from fms_fsdp_tpu.resilience.faults import configure_faults
+
+        configure_faults(cfg.faults)
+
     setup()
     setup_environ_flags()
 
@@ -80,8 +87,12 @@ def main(**kwargs):
         loader = get_data_loader(
             cfg, rank, world_size, batch_multiplier=data_extent // world_size
         )
+        # interval/final/preemption checkpoints persist this live loader's
+        # state next to the model (train_utils.train dataloader=)
+        ckpt_loader = loader
     else:
         loader = get_dummy_loader(cfg, rank, world_size)
+        ckpt_loader = None  # dummy stream is stateless
     if rank == 0:
         print("Datasets constructed!")
 
@@ -94,7 +105,12 @@ def main(**kwargs):
 
     # checkpoint load (continued pretraining or job restart)
     checkpointer = Checkpointer(
-        cfg.ckpt_save_path, 1000, cfg.sharding_strategy, rank, 0
+        cfg.ckpt_save_path,
+        1000,
+        cfg.sharding_strategy,
+        rank,
+        0,
+        verify=cfg.checkpoint_verify,
     )
     state, _, start_step, tokens_seen, is_resuming = checkpointer.load(
         state,
@@ -128,6 +144,7 @@ def main(**kwargs):
         checkpointer,
         start_step,
         tokens_seen,
+        dataloader=ckpt_loader,
     )
 
 

@@ -169,7 +169,10 @@ def main(**kwargs):
         "step": jnp.zeros((), jnp.int32),
     }
 
-    checkpointer = Checkpointer(cfg.ckpt_save_path, 1000, "ddp", rank)
+    checkpointer = Checkpointer(
+        cfg.ckpt_save_path, 1000, "ddp", rank,
+        verify=getattr(cfg, "checkpoint_verify", True),
+    )
     ckpt_loader = train_loader if hasattr(train_loader, "save_to_path") else None
     spec_state, _, start_step, tokens_seen, _ = checkpointer.load(
         spec_state,
