@@ -301,6 +301,22 @@ ROWS = [
 ]
 
 
+def _bf16_sibling_label():
+    """The headline's bf16 sibling, located structurally — the row whose
+    run_config kwargs are identical to row 0's minus the int8 quant —
+    so reordering or inserting ROWS entries can't silently mislabel
+    ``bf16_mfu`` with some other row's number. None if absent (the JSON
+    then carries bf16_mfu: null instead of a wrong value)."""
+    head_kw = {k: v for k, v in ROWS[0][1].items() if k != "quant"}
+    for label, kw in ROWS[1:]:
+        if (
+            kw.get("quant", "none") == "none"
+            and {k: v for k, v in kw.items() if k != "quant"} == head_kw
+        ):
+            return label
+    return None
+
+
 def _child_row(idx):
     """Run one row in this process and print its JSON result (child mode)."""
     label, kw = ROWS[idx]
@@ -388,8 +404,12 @@ def main():
         indices = (
             [int(i) for i in sel.split(",")] if sel else list(range(len(ROWS)))
         )
-        assert all(0 <= i < len(ROWS) for i in indices), indices
-        assert 0 in indices, "must include the headline row 0"
+        # explicit raises (not asserts): the rc=0 JSON contract must
+        # survive `python -O`, which strips assert statements entirely
+        if not all(0 <= i < len(ROWS) for i in indices):
+            raise ValueError(f"row indices out of range: {indices}")
+        if 0 not in indices:
+            raise ValueError("must include the headline row 0")
     except (ValueError, AssertionError) as e:
         # uphold the contract: bad input still yields the JSON line at rc=0
         print(
@@ -437,8 +457,12 @@ def main():
     # the headline's int8 GEMMs are measured against the reference's bf16
     # convention, and stating both numbers in the same object keeps the
     # "vs baseline" claim apples-to-apples readable (VERDICT r4 weak #8)
-    bf16_label = ROWS[1][0]
-    bf16 = next((r for r in rows if r.get("config") == bf16_label), None)
+    bf16_label = _bf16_sibling_label()
+    bf16 = (
+        next((r for r in rows if r.get("config") == bf16_label), None)
+        if bf16_label is not None
+        else None
+    )
     result = {
         "metric": f"Llama2-7B-shaped train MFU (int8 fwd+dgrad GEMMs, {n_chips}x {chip} chip)",
         "value": head.get("mfu", 0.0),

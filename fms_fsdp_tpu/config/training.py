@@ -116,6 +116,17 @@ class TrainConfig:
     use_profiler: bool = False
     profiler_rank0_only: bool = True
 
+    # observability (docs/observability.md). The print report and the
+    # wandb/aim tracker are unchanged; these knobs add the machine-
+    # readable record alongside them. obs_dir="" disables the file
+    # sinks and heartbeat; the tracker sink auto-attaches whenever
+    # cfg.tracker is set.
+    obs_dir: str = ""  # where metrics.jsonl / metrics.csv / heartbeat.json land
+    obs_sinks: str = "jsonl"  # comma list of jsonl | csv | tracker
+    obs_heartbeat: bool = True  # write heartbeat.json at report cadence
+    obs_chip_hint: str = ""  # chip gen for MFU peak ("v5e", ...); "" = env/default
+    obs_strict_schema: bool = False  # raise (don't just log) on schema violations
+
     # logging
     report_interval: int = 100
     checkpoint_interval: int = 10000

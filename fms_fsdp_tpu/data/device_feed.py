@@ -11,6 +11,7 @@ next batch's host work and H2D copy with the current device step
 
 import queue
 import threading
+import time
 from typing import Iterator, Optional
 
 import jax
@@ -44,15 +45,28 @@ class DeviceFeed:
     ``prefetch=0`` degrades to synchronous operation (useful in tests).
     """
 
-    def __init__(self, loader, mesh: Mesh, prefetch: int = 2):
+    def __init__(self, loader, mesh: Mesh, prefetch: int = 2, registry=None):
         self.loader = loader
         self.mesh = mesh
         self.prefetch = prefetch
+        # optional obs MetricRegistry: the feed thread attributes its own
+        # time (pipeline pull vs device staging) so a data-bound window
+        # is diagnosable — was the host pipeline slow, or the H2D copy?
+        # The consumer-visible data_wait phase is timed by the train
+        # loop's iterator wrapper, NOT here (no double counting).
+        self.registry = registry
+
+    def _rec(self, name: str, seconds: float) -> None:
+        if self.registry is not None:
+            self.registry.counter(name).add(seconds)
 
     def __iter__(self) -> Iterator:
         if self.prefetch <= 0:
             for batch in self.loader:
-                yield to_global_batch(batch, self.mesh)
+                t0 = time.monotonic()
+                staged = to_global_batch(batch, self.mesh)
+                self._rec("feed.stage_s", time.monotonic() - t0)
+                yield staged
             return
 
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
@@ -61,10 +75,32 @@ class DeviceFeed:
 
         def worker():
             try:
-                for batch in self.loader:
+                it = iter(self.loader)
+                while True:
+                    t0 = time.monotonic()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        # clean exhaustion: sentinel the consumer awake
+                        # (it treats None with no recorded error as end
+                        # of stream); without this a finite loader left
+                        # the consumer blocked in q.get() forever. The
+                        # stop.is_set() return below deliberately does
+                        # NOT put a sentinel — its consumer has already
+                        # left, and a put on a full queue would block
+                        # this thread for the process lifetime.
+                        q.put(None)
+                        return
+                    t1 = time.monotonic()
                     if stop.is_set():
                         return
-                    q.put(to_global_batch(batch, self.mesh))
+                    staged = to_global_batch(batch, self.mesh)
+                    t2 = time.monotonic()
+                    self._rec("feed.pipeline_s", t1 - t0)
+                    self._rec("feed.stage_s", t2 - t1)
+                    if self.registry is not None:
+                        self.registry.counter("feed.batches").add()
+                    q.put(staged)
             except BaseException as e:  # surface pipeline errors to consumer
                 err.append(e)
                 q.put(None)

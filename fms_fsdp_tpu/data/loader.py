@@ -562,12 +562,27 @@ class StatefulDataLoader:
                         # misleading "worker died (exit -15)"
                         continue
                     if not procs[w].is_alive():
+                        if stop.is_set():
+                            # shutdown landed between the check above and
+                            # the liveness probe: the dead worker is the
+                            # OLD generation's (TERMed by shutdown), not
+                            # a crash — loop back to the stale raise
+                            continue
                         exitcode = procs[w].exitcode
                         batch = RuntimeError(
                             f"loader worker {w} died (exit {exitcode})"
                         )
                         break
             if isinstance(batch, BaseException):
+                if stop.is_set():
+                    # a superseded iterator must NEVER call shutdown():
+                    # that would kill the NEW generation's workers. The
+                    # stream has moved on — raise the stale error instead
+                    raise RuntimeError(
+                        "stale loader iterator: the loader was shut down "
+                        "or re-iterated; this generation's stream has "
+                        "ended"
+                    )
                 if self._can_restart(batch, restarts, w):
                     # refork from the parent's pipeline clone. The dead
                     # worker's stream position died with it, so the

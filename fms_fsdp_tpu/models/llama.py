@@ -123,25 +123,28 @@ def attention_block(
     hd = cfg.head_dim
     nq, nkv = cfg.nheads, cfg.n_kv_heads
 
-    head_spec = P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR, None)
-    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = qmatmul(h, layer["wq"], quant=quant).reshape(b, s, nq, hd)
-    k = qmatmul(h, layer["wk"], quant=quant).reshape(b, s, nkv, hd)
-    v = qmatmul(h, layer["wv"], quant=quant).reshape(b, s, nkv, hd)
-    q = _constrain(q, head_spec, mesh)
-    k = _constrain(k, head_spec, mesh)
-    q = apply_rotary(q, cos, sin)
-    k = apply_rotary(k, cos, sin)
-    if mesh is not None and mesh.shape[AXIS_CONTEXT] > 1:
-        # sequence sharded over the context axis: ring attention keeps
-        # kv O(S/cp) per device instead of letting GSPMD all-gather it
-        from fms_fsdp_tpu.ops.ring_attention import ring_attention
+    # named scope: XPlane trace rows group under "attn" so profiler time
+    # is attributable per block half (docs/observability.md)
+    with jax.named_scope("attn"):
+        head_spec = P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR, None)
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = qmatmul(h, layer["wq"], quant=quant).reshape(b, s, nq, hd)
+        k = qmatmul(h, layer["wk"], quant=quant).reshape(b, s, nkv, hd)
+        v = qmatmul(h, layer["wv"], quant=quant).reshape(b, s, nkv, hd)
+        q = _constrain(q, head_spec, mesh)
+        k = _constrain(k, head_spec, mesh)
+        q = apply_rotary(q, cos, sin)
+        k = apply_rotary(k, cos, sin)
+        if mesh is not None and mesh.shape[AXIS_CONTEXT] > 1:
+            # sequence sharded over the context axis: ring attention keeps
+            # kv O(S/cp) per device instead of letting GSPMD all-gather it
+            from fms_fsdp_tpu.ops.ring_attention import ring_attention
 
-        o = ring_attention(q, k, v, mesh, causal=True)
-    else:
-        o = attention(q, k, v, causal=True, impl=attn_impl, mesh=mesh)
-    o = qmatmul(o.reshape(b, s, nq * hd), layer["wo"], quant=quant)
-    return x + _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+            o = ring_attention(q, k, v, mesh, causal=True)
+        else:
+            o = attention(q, k, v, causal=True, impl=attn_impl, mesh=mesh)
+        o = qmatmul(o.reshape(b, s, nq * hd), layer["wo"], quant=quant)
+        return x + _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 
 
 def _llama_block(
@@ -161,12 +164,15 @@ def _llama_block(
         x, layer, cfg, cos, sin, attn_impl=attn_impl, mesh=mesh, quant=quant
     )
 
-    h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(qmatmul(h, layer["w1"], quant=quant))
-    up = qmatmul(h, layer["w3"], quant=quant)
-    ffn = _constrain(gate * up, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh)
-    ffn = qmatmul(ffn, layer["w2"], quant=quant)
-    return x + _constrain(ffn, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
+    with jax.named_scope("ffn"):
+        h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+        gate = jax.nn.silu(qmatmul(h, layer["w1"], quant=quant))
+        up = qmatmul(h, layer["w3"], quant=quant)
+        ffn = _constrain(
+            gate * up, P(DATA_AXES, AXIS_CONTEXT, AXIS_TENSOR), mesh
+        )
+        ffn = qmatmul(ffn, layer["w2"], quant=quant)
+        return x + _constrain(ffn, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 
 
 def llama_forward(
@@ -195,7 +201,8 @@ def llama_forward(
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     from fms_fsdp_tpu.parallel.sharding import embed_lookup
 
-    x = embed_lookup(params["embedding"], tokens, mesh)
+    with jax.named_scope("embed"):
+        x = embed_lookup(params["embedding"], tokens, mesh)
 
     # RoPE positions are global; with a context axis the constraint above
     # keeps tokens sharded but positions stay absolute (table is replicated)
@@ -234,7 +241,8 @@ def llama_forward(
         # final hidden states only — the fused lm-head+CE loss consumes
         # these and never materializes full logits
         return x
-    logits = x @ params["lm_head"]
+    with jax.named_scope("lm_head"):
+        logits = x @ params["lm_head"]
     # Logits stay in compute dtype: at 128k vocab an fp32 copy is the
     # single largest buffer in the step. The loss upcasts inside its
     # reductions (fp32 logsumexp) without materializing an fp32 tensor.

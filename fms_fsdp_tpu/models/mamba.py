@@ -30,6 +30,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from fms_fsdp_tpu.models.configs import MambaConfig
+from fms_fsdp_tpu.obs.scopes import scoped
 from fms_fsdp_tpu.ops.attention import attention
 from fms_fsdp_tpu.ops.norms import rms_norm
 from fms_fsdp_tpu.ops.quant import matmul as qmatmul
@@ -127,6 +128,7 @@ def init_mamba_params(key, cfg: MambaConfig, dtype=jnp.float32) -> Params:
 from fms_fsdp_tpu.parallel.sharding import constrain as _constrain  # noqa: E402
 
 
+@scoped("mamba_mixer")
 def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh, kernel="auto", quant="none"):
     """x (B, S, D) compute dtype -> (B, S, D)."""
     B, S, d = x.shape
@@ -173,6 +175,7 @@ def _mamba_mixer(x, p: Params, cfg: MambaConfig, mesh, kernel="auto", quant="non
     return _constrain(out, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 
 
+@scoped("attn_mixer")
 def _attn_mixer(x, p: Params, cfg: MambaConfig, cos, sin, attn_impl, mesh, quant="none"):
     B, S, d = x.shape
     a = cfg.attn_cfg
@@ -204,6 +207,7 @@ def _attn_mixer(x, p: Params, cfg: MambaConfig, cos, sin, attn_impl, mesh, quant
     return _constrain(o, P(DATA_AXES, AXIS_CONTEXT, None), mesh)
 
 
+@scoped("mlp")
 def _mlp(x, p: Params, mesh, quant="none"):
     gate = jax.nn.silu(qmatmul(x, p["w1"], quant=quant))
     up = qmatmul(x, p["w3"], quant=quant)

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from fms_fsdp_tpu.models.configs import MixtralConfig
 from fms_fsdp_tpu.models.llama import attention_block
+from fms_fsdp_tpu.obs.scopes import scoped
 from fms_fsdp_tpu.ops.norms import rms_norm
 from fms_fsdp_tpu.ops.quant import expert_matmul
 from fms_fsdp_tpu.ops.rope import rope_table
@@ -140,6 +141,7 @@ def moe_capacity(cfg: MixtralConfig, seq_len: int) -> int:
     )
 
 
+@scoped("moe_router")
 def _router(h, gate_w, cfg: MixtralConfig):
     """Shared routing math: renormalized top-k weights + aux loss.
 
@@ -175,6 +177,7 @@ def _moe_stats(aux, keep=None):
     return {"balance": aux, "drop_frac": drop}
 
 
+@scoped("moe_dense")
 def _moe_ffn_dense(h, lp, cfg: MixtralConfig):
     """Dense-mix top-k MoE SwiGLU (every expert computes every token).
     h (B, S, D); w1/w3 (E, D, H); w2 (E, H, D)."""
@@ -232,6 +235,7 @@ def _expert_swiglu(xd, w1, w3, w2, quant, constrain_hidden=lambda t: t):
     return expert_matmul(constrain_hidden(hidden), w2, quant=quant)
 
 
+@scoped("expert_ffn")
 def _expert_ffn(xd, lp, mesh, quant: str = "none"):
     """Expert SwiGLU with full GSPMD sharding: E over "expert", batch
     over replica/fsdp, hidden width over "tensor"."""
@@ -280,6 +284,7 @@ def _combine_from_buffer(out_e, dest, top_w, S: int):
     return jnp.einsum("bskd,bsk->bsd", gathered, top_w.astype(out_e.dtype))
 
 
+@scoped("moe_dispatch")
 def _moe_ffn_dispatch(
     h, lp, cfg: MixtralConfig, mesh: Optional[Mesh], quant: str = "none"
 ):
@@ -360,6 +365,7 @@ def _use_expert_a2a(
     return True
 
 
+@scoped("moe_dispatch_a2a")
 def _moe_ffn_dispatch_a2a(
     h, lp, cfg: MixtralConfig, mesh: Mesh, quant: str = "none"
 ):

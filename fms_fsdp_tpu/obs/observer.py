@@ -1,0 +1,254 @@
+"""The Observer facade the train loops drive.
+
+One object owns the registry, phase timer, goodput tracker, sinks, and
+heartbeat. The hot loop touches it in exactly three ways:
+
+- ``wrap_data_iter(it)`` — times each ``next()`` as ``data_wait``;
+- ``phase(name)`` — context manager around step dispatch / metric fetch
+  (``compute``) and checkpoint saves (``checkpoint``);
+- ``report(...)`` — once per report interval: folds the phase window,
+  skipped-step counts, and MFU/HFU into a schema-validated record and
+  fans it out to every sink plus the heartbeat.
+
+Ranks other than 0 get the same timer/registry (phases are cheap and
+keeping them armed avoids rank-divergent control flow) but no sinks —
+only rank 0 writes files or talks to trackers.
+"""
+
+import logging
+import math
+import time
+from typing import Callable, Dict, Iterable, Iterator, List, Optional
+
+from fms_fsdp_tpu.obs.registry import MetricRegistry
+from fms_fsdp_tpu.obs.schema import SCHEMA_VERSION, validate_record
+from fms_fsdp_tpu.obs.sinks import Heartbeat, Sink, build_sinks
+from fms_fsdp_tpu.obs.timing import GoodputTracker, PhaseTimer
+
+logger = logging.getLogger(__name__)
+
+
+def _nonfinite(v) -> bool:
+    return isinstance(v, float) and not math.isfinite(v)
+
+
+class Observer:
+    def __init__(
+        self,
+        sinks: Optional[List[Sink]] = None,
+        heartbeat: Optional[Heartbeat] = None,
+        flops_per_token: Optional[float] = None,
+        hfu_flops_per_token: Optional[float] = None,
+        peak_flops: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        strict_schema: bool = False,
+    ):
+        self.registry = MetricRegistry()
+        self.timer = PhaseTimer(clock=clock)
+        self.goodput = GoodputTracker()
+        self.sinks = sinks or []
+        self.heartbeat = heartbeat
+        self.flops_per_token = flops_per_token
+        self.hfu_flops_per_token = hfu_flops_per_token
+        self.peak_flops = peak_flops
+        self.strict_schema = strict_schema
+        self.last_record: Optional[Dict] = None
+        self._schema_warned = False
+
+    # -- hot-loop hooks ----------------------------------------------------
+
+    def phase(self, name: str):
+        return self.timer.phase(name)
+
+    def wrap_data_iter(self, it: Iterable) -> Iterator:
+        """Yield from ``it`` with each ``next()`` timed as data_wait."""
+        it = iter(it)
+        while True:
+            try:
+                with self.timer.phase("data_wait"):
+                    item = next(it)
+            except StopIteration:
+                return
+            yield item
+
+    # -- report-cadence ----------------------------------------------------
+
+    def report(
+        self,
+        step: int,
+        steps_in_window: int,
+        *,
+        loss: float,
+        tokens_per_sec_per_chip: float,
+        skipped_steps_total: int = 0,
+        skipped_steps_window: int = 0,
+        grad_norm: Optional[float] = None,
+        learning_rate: Optional[float] = None,
+        tokens_seen: Optional[int] = None,
+        tokens_per_sec_per_chip_overall: Optional[float] = None,
+        step_time_s: Optional[float] = None,
+        memory_reserved_bytes: Optional[int] = None,
+        memory_allocated_bytes: Optional[int] = None,
+        extra: Optional[Dict[str, float]] = None,
+    ) -> Dict:
+        """Close the phase window, derive goodput/MFU, emit to sinks.
+
+        Returns the record (also kept as ``last_record`` for tests and
+        callers that want the derived numbers)."""
+        window = self.timer.window()
+        goodput_w, goodput_all = self.goodput.update(
+            window, steps_in_window, skipped_steps_window
+        )
+        mfu = hfu = None
+        if self.flops_per_token and self.peak_flops:
+            achieved = tokens_per_sec_per_chip * self.flops_per_token
+            mfu = achieved / self.peak_flops
+            if self.hfu_flops_per_token:
+                hfu = (
+                    tokens_per_sec_per_chip
+                    * self.hfu_flops_per_token
+                    / self.peak_flops
+                )
+        extras = dict(self.registry.snapshot())
+        if extra:
+            extras.update(extra)
+        wall = window["wall"]
+        record = {
+            "schema_version": SCHEMA_VERSION,
+            "step": int(step),
+            "time_unix": time.time(),
+            "loss": float(loss),
+            "grad_norm": None if grad_norm is None else float(grad_norm),
+            "learning_rate": (
+                None if learning_rate is None else float(learning_rate)
+            ),
+            "tokens_seen": None if tokens_seen is None else int(tokens_seen),
+            "tokens_per_sec_per_chip": float(tokens_per_sec_per_chip),
+            "tokens_per_sec_per_chip_overall": (
+                None
+                if tokens_per_sec_per_chip_overall is None
+                else float(tokens_per_sec_per_chip_overall)
+            ),
+            "step_time_s": (
+                None if step_time_s is None else float(step_time_s)
+            ),
+            "mfu": mfu,
+            "hfu": hfu,
+            "data_wait_s": window["data_wait"],
+            "data_wait_frac": (
+                window["data_wait"] / wall if wall > 0 else 0.0
+            ),
+            "compute_s": window["compute"],
+            "checkpoint_s": window["checkpoint"],
+            "wall_s": wall,
+            "goodput": goodput_w,
+            "goodput_overall": goodput_all,
+            "skipped_steps": int(skipped_steps_total),
+            "skipped_steps_window": int(skipped_steps_window),
+            "memory_reserved_bytes": (
+                None
+                if memory_reserved_bytes is None
+                else int(memory_reserved_bytes)
+            ),
+            "memory_allocated_bytes": (
+                None
+                if memory_allocated_bytes is None
+                else int(memory_allocated_bytes)
+            ),
+            "extra": extras,
+        }
+        # non-finite scalars become null: a NaN loss (fully-poisoned
+        # window) serialized bare would make the JSONL line unparseable
+        # by strict parsers exactly when the post-mortem matters most
+        record = {
+            k: (None if _nonfinite(v) else v) for k, v in record.items()
+        }
+        record["extra"] = {
+            k: (None if _nonfinite(v) else v) for k, v in extras.items()
+        }
+        errs = validate_record(record)
+        if errs:
+            if self.strict_schema:
+                raise ValueError(f"metrics record violates schema: {errs}")
+            if not self._schema_warned:
+                # warn once (not per report): downstream consumers are
+                # about to choke on this stream and the operator needs
+                # a signal, but a per-report warning would flood logs
+                self._schema_warned = True
+                logger.warning(
+                    "metrics record violates schema (emitting anyway; "
+                    "set obs_strict_schema=True to raise): %s", errs
+                )
+        self.last_record = record
+        for sink in self.sinks:
+            sink.emit(record)
+        if self.heartbeat:
+            self.heartbeat.beat(step, record["time_unix"], goodput_w)
+        return record
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            sink.close()
+
+
+def build_observer(
+    cfg,
+    rank: int,
+    model_cfg=None,
+    tracker_fn: Optional[Callable] = None,
+    clock: Callable[[], float] = time.monotonic,
+) -> Observer:
+    """Build the Observer from TrainConfig knobs (docs/observability.md).
+
+    File sinks and the heartbeat attach only on rank 0 and only when
+    ``cfg.obs_dir`` is set; the tracker sink attaches whenever a live
+    ``tracker_fn`` exists (rank 0 by construction — ``get_tracker``
+    returns None elsewhere). MFU/HFU need ``model_cfg`` for the FLOPs
+    model; without it they are emitted as null.
+    """
+    import os
+
+    obs_dir = getattr(cfg, "obs_dir", "") or ""
+    names = [
+        s for s in (getattr(cfg, "obs_sinks", "jsonl") or "").split(",") if s
+    ]
+    # the legacy tracker rides as a sink whenever configured, even if the
+    # user's obs_sinks list predates the tracker sink name
+    if tracker_fn is not None and "tracker" not in [n.strip() for n in names]:
+        names.append("tracker")
+    sinks = build_sinks(obs_dir if rank == 0 else "", names, tracker_fn)
+    heartbeat = None
+    if rank == 0 and obs_dir and getattr(cfg, "obs_heartbeat", True):
+        heartbeat = Heartbeat(os.path.join(obs_dir, "heartbeat.json"))
+
+    flops = hfu_flops = peak = None
+    if model_cfg is not None:
+        from fms_fsdp_tpu.parallel.ac import selective_ac_mask
+        from fms_fsdp_tpu.utils.flops import (
+            peak_flops_per_chip,
+            train_flops_per_token,
+        )
+
+        seq_len = cfg.seq_length
+        flops = train_flops_per_token(model_cfg, seq_len)
+        ac_actual = 0.0
+        if getattr(cfg, "fsdp_activation_checkpointing", False):
+            n_layers = getattr(model_cfg, "nlayers", None) or getattr(
+                model_cfg, "n_layer", 1
+            )
+            mask = selective_ac_mask(n_layers, cfg.selective_checkpointing)
+            ac_actual = (sum(mask) / n_layers) if mask else 0.0
+        hfu_flops = train_flops_per_token(
+            model_cfg, seq_len, ac_fraction=ac_actual
+        )
+        peak = peak_flops_per_chip(getattr(cfg, "obs_chip_hint", "") or "")
+
+    return Observer(
+        sinks=sinks,
+        heartbeat=heartbeat,
+        flops_per_token=flops,
+        hfu_flops_per_token=hfu_flops,
+        peak_flops=peak,
+        clock=clock,
+        strict_schema=bool(getattr(cfg, "obs_strict_schema", False)),
+    )

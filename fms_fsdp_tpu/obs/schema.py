@@ -1,0 +1,106 @@
+"""The versioned metrics-record schema shared by every sink.
+
+One record is emitted per report step. ``SCHEMA_FIELDS`` is the
+contract: field name -> (type tag, required). Changing the field set or
+a type WITHOUT bumping ``SCHEMA_VERSION`` fails CI: the pinned digest in
+``SCHEMA_DIGESTS`` no longer matches (tests/test_obs.py::
+test_schema_digest_pins_version). To evolve the schema: edit
+``SCHEMA_FIELDS``, bump ``SCHEMA_VERSION``, add the new digest (printed
+by the failing test), and document the change in docs/observability.md.
+
+Type tags: ``int`` / ``float`` (``null`` allowed only where required is
+False) / ``str`` / ``map`` (flat str->number dict).
+"""
+
+import hashlib
+import json
+import numbers
+from typing import Any, Dict, List
+
+SCHEMA_VERSION = 1
+
+# name -> (type, required)
+SCHEMA_FIELDS = {
+    "schema_version": ("int", True),
+    "step": ("int", True),
+    "time_unix": ("float", True),
+    # nullable: a fully-poisoned report window (every step flagged
+    # non-finite) has no finite loss to state — null, never bare NaN,
+    # keeps each line strict-JSON parseable exactly when the post-mortem
+    # matters most; skipped_steps_window == steps tells the story
+    "loss": ("float", False),
+    "grad_norm": ("float", False),
+    "learning_rate": ("float", False),
+    "tokens_seen": ("int", False),
+    "tokens_per_sec_per_chip": ("float", True),
+    "tokens_per_sec_per_chip_overall": ("float", False),
+    "step_time_s": ("float", False),
+    "mfu": ("float", False),
+    "hfu": ("float", False),
+    "data_wait_s": ("float", True),
+    "data_wait_frac": ("float", True),
+    "compute_s": ("float", True),
+    "checkpoint_s": ("float", True),
+    "wall_s": ("float", True),
+    "goodput": ("float", True),
+    "goodput_overall": ("float", False),
+    "skipped_steps": ("int", True),
+    "skipped_steps_window": ("int", True),
+    "memory_reserved_bytes": ("int", False),
+    "memory_allocated_bytes": ("int", False),
+    "extra": ("map", False),
+}
+
+# Digest of the canonical field serialization for each published
+# version. A mismatch for the CURRENT version means the schema changed
+# without a version bump.
+SCHEMA_DIGESTS = {
+    1: "01cf2035086946667a852893e38535f44bd340e20871a10be2d6f4103cd62f90",
+}
+
+
+def schema_digest() -> str:
+    canon = json.dumps(
+        {"version": SCHEMA_VERSION, "fields": SCHEMA_FIELDS},
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+def _type_ok(tag: str, v: Any) -> bool:
+    if tag == "int":
+        return isinstance(v, numbers.Integral) and not isinstance(v, bool)
+    if tag == "float":
+        return isinstance(v, numbers.Real) and not isinstance(v, bool)
+    if tag == "str":
+        return isinstance(v, str)
+    if tag == "map":
+        return isinstance(v, dict) and all(
+            isinstance(k, str)
+            and (v[k] is None or isinstance(v[k], numbers.Real))
+            for k in v
+        )
+    return False
+
+
+def validate_record(rec: Dict[str, Any]) -> List[str]:
+    """Return a list of violations (empty = valid). Checks: required
+    fields present and non-null, all present fields well-typed, no
+    fields outside the schema, version matches."""
+    errs = []
+    if rec.get("schema_version") != SCHEMA_VERSION:
+        errs.append(
+            f"schema_version {rec.get('schema_version')!r} != {SCHEMA_VERSION}"
+        )
+    for name, (tag, required) in SCHEMA_FIELDS.items():
+        if name not in rec or rec[name] is None:
+            if required:
+                errs.append(f"missing required field {name!r}")
+            continue
+        if not _type_ok(tag, rec[name]):
+            errs.append(f"field {name!r}={rec[name]!r} is not a {tag}")
+    for name in rec:
+        if name not in SCHEMA_FIELDS:
+            errs.append(f"unknown field {name!r} (bump SCHEMA_VERSION?)")
+    return errs

@@ -1,0 +1,29 @@
+"""``jax.named_scope`` decorator for trace attribution.
+
+Profiler traces (utils/train_utils.py::WindowedProfiler) are only as
+useful as their op names; a scan-of-blocks model otherwise shows up as
+one undifferentiated ``while`` region. ``scoped("name")`` wraps a
+trace-time function so every op it emits lands under ``name`` in the
+XPlane tree — zero runtime cost (named_scope only affects tracing
+metadata), safe inside jit/scan/remat, and a no-op for code paths that
+never run under a profiler.
+"""
+
+import functools
+
+import jax
+
+
+def scoped(name: str):
+    """Decorator: run the wrapped trace function under
+    ``jax.named_scope(name)``."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with jax.named_scope(name):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    return deco

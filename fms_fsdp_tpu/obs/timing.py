@@ -1,0 +1,117 @@
+"""Step-phase wall-time decomposition and goodput accounting.
+
+The hot loop's wall clock splits into four phases:
+
+- ``data_wait`` — host blocked waiting for the next batch (the loop's
+  ``next()`` on the feed iterator);
+- ``compute``  — dispatching the jitted step plus the once-per-report
+  ``device_get`` where the device actually catches up (the loop only
+  *dispatches* asynchronously, so per-step host compute time is near
+  zero and the report-time fetch is where a window's device time
+  manifests);
+- ``checkpoint`` — inside ``Checkpointer.save``;
+- ``other``    — the remainder (python overhead, tracker IO, prints).
+
+Goodput is the fraction of wall time spent making *useful* training
+progress: compute time scaled by the window's clean-step fraction
+(steps whose updates the anomaly guard skipped produced no progress),
+over total wall time. Data stalls, checkpoint stalls, and skipped steps
+all pull goodput below MFU's hardware-only story — which is exactly the
+gap the metric exists to expose.
+"""
+
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict
+
+
+PHASES = ("data_wait", "compute", "checkpoint", "other")
+
+
+class PhaseTimer:
+    """Accumulates wall seconds per phase; windowed at report cadence.
+
+    ``clock`` is injectable for tests (defaults to ``time.monotonic``).
+    Phases may nest across components (e.g. a checkpoint save inside the
+    loop body): inner phases win — time inside an inner ``phase()`` is
+    attributed to the inner phase only, via depth bookkeeping on entry
+    and exit.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._acc: Dict[str, float] = {p: 0.0 for p in PHASES}
+        self._stack = []
+        self._window_start = clock()
+
+    def record(self, name: str, seconds: float) -> None:
+        """Directly attribute ``seconds`` to ``name`` (for callers that
+        measured a wait themselves, e.g. a feed thread)."""
+        self._acc[name] = self._acc.get(name, 0.0) + seconds
+
+    @contextmanager
+    def phase(self, name: str):
+        start = self._clock()
+        if self._stack:
+            # suspend the enclosing phase: attribute its elapsed-so-far
+            # and let the inner phase own the clock from here
+            outer_name, outer_start = self._stack[-1]
+            self.record(outer_name, start - outer_start)
+        self._stack.append((name, start))
+        try:
+            yield
+        finally:
+            end = self._clock()
+            self.record(name, end - self._stack.pop()[1])
+            if self._stack:
+                # resume the outer phase from now
+                self._stack[-1] = (self._stack[-1][0], end)
+
+    def window(self) -> Dict[str, float]:
+        """Close the current report window: return per-phase seconds with
+        ``other`` as the unattributed remainder and ``wall`` as the
+        window's total, then reset the accumulators."""
+        now = self._clock()
+        wall = max(0.0, now - self._window_start)
+        self._window_start = now
+        out = {p: self._acc.get(p, 0.0) for p in PHASES}
+        for k in self._acc:
+            if k not in out:
+                out[k] = self._acc[k]
+        attributed = sum(v for k, v in out.items() if k != "other")
+        out["other"] += max(0.0, wall - attributed)
+        out["wall"] = wall
+        self._acc = {p: 0.0 for p in PHASES}
+        return out
+
+
+class GoodputTracker:
+    """Folds phase windows + skipped-step counts into goodput.
+
+    ``update`` consumes one report window and returns
+    ``(goodput_window, goodput_overall)``; cumulative totals live here
+    so the overall number survives across windows.
+    """
+
+    def __init__(self):
+        self.productive_s = 0.0
+        self.wall_s = 0.0
+
+    def update(
+        self,
+        window: Dict[str, float],
+        steps: int,
+        skipped_steps: int = 0,
+    ):
+        wall = window.get("wall", 0.0)
+        compute = window.get("compute", 0.0)
+        steps = max(1, steps)
+        clean_frac = max(0.0, (steps - skipped_steps) / steps)
+        productive = compute * clean_frac
+        self.productive_s += productive
+        self.wall_s += wall
+        goodput_window = productive / wall if wall > 0 else 0.0
+        goodput_overall = (
+            self.productive_s / self.wall_s if self.wall_s > 0 else 0.0
+        )
+        return goodput_window, goodput_overall

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fms_fsdp_tpu.obs.scopes import scoped
 from fms_fsdp_tpu.parallel.compat import tpu_compiler_params
 
 NEG_INF = -1e30
@@ -118,6 +119,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k, causal):
     lse_ref[0, 0] = m * LN2 + jnp.log(l)
 
 
+@scoped("flash_attention_fwd")
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     """q: (B, Nq, Sq, H); k/v: (B, Nkv, Sk, H) -> (o, lse).
 
@@ -715,6 +717,7 @@ def flash_dkv(q, k, v, dout, lse, delta, *, scale, causal, block_q, block_k, int
     return dk, dv
 
 
+@scoped("flash_attention_bwd")
 def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout, dlse=None):
     """Backward for o (and optionally the lse output).
 

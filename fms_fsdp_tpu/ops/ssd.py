@@ -30,6 +30,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from fms_fsdp_tpu.obs.scopes import scoped
 from fms_fsdp_tpu.parallel.compat import tpu_compiler_params
 
 from fms_fsdp_tpu.ops.flash_attention import NEG_INF
@@ -291,6 +292,7 @@ def _ssd_chunk(s_prev, xc, dtc, ac, Bc, Cc, G):
     return y, s_new
 
 
+@scoped("ssd_scan")
 def ssd_scan(
     x, dt, A, Bm, Cm, D=None, chunk_size: int = 256, kernel: str = "auto",
     mesh=None,
@@ -409,6 +411,7 @@ def _ssd_core_xla(x, dtf, a, Bm, Cm, L, return_state: bool = False):
     return y
 
 
+@scoped("ssd_scan_cp")
 def ssd_scan_cp(
     x, dt, A, Bm, Cm, D=None, *, mesh, chunk_size: int = 256, kernel: str = "auto"
 ):
@@ -544,6 +547,7 @@ def ssd_scan_reference(x, dt, A, Bm, Cm, D=None):
     return y.astype(x.dtype)
 
 
+@scoped("causal_conv1d")
 def causal_conv1d(x, weight, bias=None, activation: str = "silu"):
     """Depthwise causal conv over (B, S, C) with kernel (C, W), the
     mamba_ssm causal_conv1d equivalent.

@@ -18,6 +18,7 @@ of burning the reservation on a hang.
 
 import contextlib
 import faulthandler
+import json
 import logging
 import os
 import sys
@@ -71,14 +72,23 @@ class StepWatchdog:
     exceeds ``timeout_s`` it dumps every thread's stack via faulthandler
     (the post-mortem for "which collective wedged") and ``os._exit``\\ s
     with :data:`EXIT_CODE` — a stuck collective must not hang forever.
+
+    ``heartbeat_path`` (optional) points at the observability layer's
+    heartbeat file (obs/sinks.py::Heartbeat — {step, time_unix,
+    goodput}); the stall report quotes its last contents so the
+    post-mortem states exactly how far the run got and how healthy it
+    was when it wedged. External orchestrators poll the same file.
     """
 
     EXIT_CODE = 2
 
-    def __init__(self, timeout_s: float, poll_s: float = None):
+    def __init__(
+        self, timeout_s: float, poll_s: float = None, heartbeat_path=None
+    ):
         assert timeout_s > 0
         self.timeout_s = timeout_s
         self.poll_s = min(1.0, timeout_s / 4) if poll_s is None else poll_s
+        self.heartbeat_path = heartbeat_path
         self._last_beat = time.monotonic()
         self._paused = 0
         self._stop = threading.Event()
@@ -123,6 +133,19 @@ class StepWatchdog:
                     f"{stalled:.1f}s (timeout {self.timeout_s}s); dumping "
                     f"stacks and exiting {self.EXIT_CODE}\n"
                 )
+                if self.heartbeat_path:
+                    # read inline (no project imports): the process is
+                    # wedged — the stall path must not risk an import
+                    # lock held by the stuck main thread
+                    try:
+                        with open(self.heartbeat_path) as f:
+                            hb = json.load(f)
+                    except (OSError, ValueError):
+                        hb = None
+                    sys.stderr.write(
+                        f"step watchdog: last heartbeat "
+                        f"({self.heartbeat_path}): {hb}\n"
+                    )
                 sys.stderr.flush()
                 try:
                     faulthandler.dump_traceback(file=sys.stderr, all_threads=True)

@@ -228,12 +228,19 @@ def train_speculator(
     ckpt_loader=None,
     base_api=None,
     mesh=None,
+    observer=None,
 ):
     """Speculator host loop with the reference's reporting/ckpt cadence
     (ref:train_speculator_utils.py:263-427). ``train_loader`` yields global
     input batches (e.g. a DeviceFeed); ``ckpt_loader`` is the stateful
     pipeline object whose state gets checkpointed (defaults to
-    train_loader when it exposes save_to_path)."""
+    train_loader when it exposes save_to_path).
+
+    ``observer`` (obs/) emits the same schema-versioned metrics.jsonl /
+    heartbeat as the pretraining loop; MFU/HFU are null here — the wall
+    time is dominated by the *frozen* base forward (stage 1) or
+    generation (stage 2), which the trained-model FLOPs convention does
+    not count."""
     stage1 = make_stage1_step(
         base_params, model_cfg, scfg, cfg, optimizer, base_api, mesh=mesh
     )
@@ -252,6 +259,13 @@ def train_speculator(
     )
     from fms_fsdp_tpu.utils.train_utils import PreemptionGuard
 
+    if observer is None:
+        from fms_fsdp_tpu.obs import build_observer
+
+        observer = build_observer(cfg, rank)
+    checkpointer.observer = observer
+    train_loader = observer.wrap_data_iter(train_loader)
+
     window = []
     elapsed_tokens = 0
     start = time.time()
@@ -259,83 +273,112 @@ def train_speculator(
     step_tok = 0
     preemption = PreemptionGuard().install()
 
-    for batch_idx, inputs in enumerate(train_loader, start=start_step + 1):
-        if batch_idx > cfg.num_steps:
-            break
-        if isinstance(inputs, tuple):
-            inputs = inputs[0]
-        if not isinstance(inputs, jax.Array):
-            inputs = jnp.asarray(inputs, jnp.int32)
+    try:
+        for batch_idx, inputs in enumerate(train_loader, start=start_step + 1):
+            if batch_idx > cfg.num_steps:
+                break
+            if isinstance(inputs, tuple):
+                inputs = inputs[0]
+            if not isinstance(inputs, jax.Array):
+                inputs = jnp.asarray(inputs, jnp.int32)
 
-        if batch_idx <= cfg.stage2_start_step:
-            spec_state, metrics = stage1(spec_state, inputs)
-            # global arrays: .size already counts the full global batch
-            step_tok = inputs.size
-        else:
-            if stage2 is None:
-                stage2 = make_stage2_step(
-                    base_params, model_cfg, scfg, cfg, optimizer, base_api
-                )
-            key, sub = jax.random.split(key)
-            spec_state, metrics = stage2(spec_state, inputs, sub)
-            grow = cfg.stage2_batch_size // cfg.batch_size
-            step_tok = inputs.shape[0] * grow * cfg.stage2_seq_length
-        window.append(metrics)
+            with observer.phase("compute"):
+                if batch_idx <= cfg.stage2_start_step:
+                    spec_state, metrics = stage1(spec_state, inputs)
+                    # global arrays: .size already counts the full global batch
+                    step_tok = inputs.size
+                else:
+                    if stage2 is None:
+                        stage2 = make_stage2_step(
+                            base_params, model_cfg, scfg, cfg, optimizer, base_api
+                        )
+                    key, sub = jax.random.split(key)
+                    spec_state, metrics = stage2(spec_state, inputs, sub)
+                    grow = cfg.stage2_batch_size // cfg.batch_size
+                    step_tok = inputs.shape[0] * grow * cfg.stage2_seq_length
+            window.append(metrics)
 
-        if profiler:
-            profiler.step()
+            if profiler:
+                profiler.step()
 
-        if batch_idx % cfg.report_interval == 0:
-            fetched = jax.device_get(window)
-            window = []
-            per_head = np.mean([m["per_head"] for m in fetched], axis=0)
-            g_norm = float(np.mean([m["gnorm"] for m in fetched]))
-            elapsed_time = time.time() - loop_start
-            elapsed_tokens += cfg.report_interval * step_tok
-            if rank == 0:
-                print(f"{time.time()}")
-                print("step:", batch_idx)
-                print("tokens seen:", n_tok + elapsed_tokens)
-                for i in range(len(per_head)):
-                    print(f"loss {i + 1}:", float(per_head[i]))
-                print("gradient norm:", g_norm)
-                print(
-                    f"speed for these {cfg.report_interval} steps:",
-                    (time.time() - start) / cfg.report_interval,
+            if batch_idx % cfg.report_interval == 0:
+                with observer.phase("compute"):
+                    fetched = jax.device_get(window)
+                window = []
+                per_head = np.mean([m["per_head"] for m in fetched], axis=0)
+                g_norm = float(np.mean([m["gnorm"] for m in fetched]))
+                elapsed_time = time.time() - loop_start
+                elapsed_tokens += cfg.report_interval * step_tok
+                if rank == 0:
+                    print(f"{time.time()}")
+                    print("step:", batch_idx)
+                    print("tokens seen:", n_tok + elapsed_tokens)
+                    for i in range(len(per_head)):
+                        print(f"loss {i + 1}:", float(per_head[i]))
+                    print("gradient norm:", g_norm)
+                    print(
+                        f"speed for these {cfg.report_interval} steps:",
+                        (time.time() - start) / cfg.report_interval,
+                    )
+                    print("overall speed:", elapsed_time / (batch_idx - start_step))
+                    print("LR:", float(fetched[-1]["lr"]))
+                    print(
+                        "overall token per chip per sec:",
+                        int(elapsed_tokens / world_size / elapsed_time),
+                    )
+                    print(
+                        "token per day:",
+                        int(elapsed_tokens / elapsed_time * 3600 * 24),
+                    )
+                    print()
+                window_wall = max(1e-9, time.time() - start)
+                # rates priced on the TRUE window step count (a resume's
+                # first window is partial) at the last step's token size —
+                # a window straddling the stage1->stage2 switch is an
+                # approximation either way
+                window_steps = max(1, len(fetched))
+                observer.report(
+                    batch_idx,
+                    len(fetched),
+                    loss=float(np.mean([m["loss"] for m in fetched])),
+                    grad_norm=g_norm,
+                    learning_rate=float(fetched[-1]["lr"]),
+                    tokens_seen=n_tok + elapsed_tokens,
+                    tokens_per_sec_per_chip=(
+                        window_steps * step_tok / world_size / window_wall
+                    ),
+                    tokens_per_sec_per_chip_overall=(
+                        elapsed_tokens / world_size / max(1e-9, elapsed_time)
+                    ),
+                    step_time_s=window_wall / window_steps,
+                    extra={
+                        f"loss_head_{i + 1}": float(per_head[i])
+                        for i in range(len(per_head))
+                    },
                 )
-                print("overall speed:", elapsed_time / (batch_idx - start_step))
-                print("LR:", float(fetched[-1]["lr"]))
-                print(
-                    "overall token per chip per sec:",
-                    int(elapsed_tokens / world_size / elapsed_time),
-                )
-                print(
-                    "token per day:",
-                    int(elapsed_tokens / elapsed_time * 3600 * 24),
-                )
-                print()
-            start = time.time()
+                start = time.time()
 
-        preempt_now = preemption.poll()
-        if (
-            batch_idx % cfg.checkpoint_interval == 0
-            or batch_idx == cfg.num_steps
-            or do_ckpt(cfg.ckpt_save_path) is True
-            or preempt_now
-        ):
-            checkpointer.save(
-                batch_idx,
-                spec_state,
-                ckpt_loader,
-                tokens_seen=elapsed_tokens + n_tok,
-            )
-            do_ckpt(cfg.ckpt_save_path, reset=True)
-        if preempt_now:
-            if rank == 0:
-                print(
-                    f"preemption signal received: checkpoint saved at step "
-                    f"{batch_idx}, exiting clean"
+            preempt_now = preemption.poll()
+            if (
+                batch_idx % cfg.checkpoint_interval == 0
+                or batch_idx == cfg.num_steps
+                or do_ckpt(cfg.ckpt_save_path) is True
+                or preempt_now
+            ):
+                checkpointer.save(
+                    batch_idx,
+                    spec_state,
+                    ckpt_loader,
+                    tokens_seen=elapsed_tokens + n_tok,
                 )
-            break
-
+                do_ckpt(cfg.ckpt_save_path, reset=True)
+            if preempt_now:
+                if rank == 0:
+                    print(
+                        f"preemption signal received: checkpoint saved at step "
+                        f"{batch_idx}, exiting clean"
+                    )
+                break
+    finally:
+        observer.close()
     return spec_state

@@ -164,6 +164,22 @@ def init_train_state(
     return jax.jit(init_fn, out_shardings=shardings)(rng), shardings
 
 
+def wrap_step_fn(step_fn, timer):
+    """Host-side observability wrapper over the jitted step: attribute
+    dispatch wall time to the ``compute`` phase (obs/timing.py). Dispatch
+    is asynchronous — per-step host time here is microseconds once XLA's
+    queue is ahead — but it is the hook where a *blocked* dispatch
+    (device queue full, i.e. genuinely compute-bound) becomes visible,
+    and the once-per-report ``device_get`` (also attributed to compute
+    by the loop) accounts the rest of the window's device time."""
+
+    def stepped(state, batch):
+        with timer.phase("compute"):
+            return step_fn(state, batch)
+
+    return stepped
+
+
 def make_train_step(
     model_cfg,
     cfg,
@@ -272,9 +288,12 @@ def make_train_step(
         params_c = jax.tree.map(
             lambda p: p.astype(policy.compute_dtype), state["params"]
         )
-        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params_c, inputs, labels
-        )
+        # named scopes bracket the trace so WindowedProfiler XPlane rows
+        # attribute device time to fwd_bwd vs optimizer (docs/observability.md)
+        with jax.named_scope("fwd_bwd"):
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                params_c, inputs, labels
+            )
         if nan_fault is not None:
             # injected non-finite batch: poison loss AND grads for steps
             # [step, step+count) — the NaN-batch failure the guard below
@@ -312,8 +331,11 @@ def make_train_step(
         opt_state = state["opt_state"]._replace(
             hyperparams=dict(state["opt_state"].hyperparams, learning_rate=lr)
         )
-        updates, opt_state = optimizer.update(grads, opt_state, state["params"])
-        params = optax.apply_updates(state["params"], updates)
+        with jax.named_scope("optimizer"):
+            updates, opt_state = optimizer.update(
+                grads, opt_state, state["params"]
+            )
+            params = optax.apply_updates(state["params"], updates)
         if guard_updates:
             # fully skip the update: even zeroed grads decay Adam moments
             # and apply weight decay — carry the old state forward. This

@@ -143,6 +143,11 @@ class Checkpointer:
     # unchanged mtime across cleanup passes before it is pruned
     PRUNE_QUIESCE_S = 60.0
 
+    # observability hook (obs/observer.py): when the train loop attaches
+    # its Observer here, save() wall time lands in the "checkpoint"
+    # phase of the step-time decomposition and the save counters
+    observer = None
+
     def __init__(
         self,
         ckpdir: str,
@@ -382,28 +387,37 @@ class Checkpointer:
         metadata.json (the commit marker, atomic rename). A save torn
         before the marker leaves an uncommitted dir every scanner skips;
         a committed checkpoint always has a verifiable manifest."""
+        from contextlib import nullcontext
+
         from fms_fsdp_tpu.resilience.integrity import write_manifest
 
+        obs = self.observer
         save_time = time.time()
-        save_name = os.path.join(self.ckp_path, f"step_{step}_ckp")
-        os.makedirs(save_name, exist_ok=True)
+        with obs.phase("checkpoint") if obs is not None else nullcontext():
+            save_name = os.path.join(self.ckp_path, f"step_{step}_ckp")
+            os.makedirs(save_name, exist_ok=True)
 
-        self._ckptr.save(
-            os.path.join(save_name, "state"), state, force=True
-        )
-        self._ckptr.wait_until_finished()
-        if dataloader is not None:
-            dataloader.save_to_path(save_name)
-        if self.rank == 0:
-            write_manifest(save_name)
-            metadata["step"] = step
-            meta_path = os.path.join(save_name, "metadata.json")
-            with open(meta_path + ".tmp", "w") as f:
-                json.dump(metadata, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(meta_path + ".tmp", meta_path)
-            self._maybe_corrupt(save_name, step)
+            self._ckptr.save(
+                os.path.join(save_name, "state"), state, force=True
+            )
+            self._ckptr.wait_until_finished()
+            if dataloader is not None:
+                dataloader.save_to_path(save_name)
+            if self.rank == 0:
+                write_manifest(save_name)
+                metadata["step"] = step
+                meta_path = os.path.join(save_name, "metadata.json")
+                with open(meta_path + ".tmp", "w") as f:
+                    json.dump(metadata, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(meta_path + ".tmp", meta_path)
+                self._maybe_corrupt(save_name, step)
+        if obs is not None:
+            obs.registry.counter("checkpoint.saves").add()
+            obs.registry.hist("checkpoint.save_s").record(
+                time.time() - save_time
+            )
         self.report(
             f"Checkpoint saved in {save_name}",
             model_save_time=time.time() - save_time,

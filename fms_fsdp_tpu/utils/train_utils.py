@@ -253,6 +253,8 @@ def train(
     start_step,
     tokens_seen,
     dataloader=None,
+    model_cfg=None,
+    observer=None,
 ):
     """Run the hot loop to cfg.num_steps. Returns the final reported loss.
 
@@ -261,8 +263,22 @@ def train(
     interval/final/preemption checkpoints persist the live loader state
     into the same ``step_N_ckp`` dir as the model, so a resume continues
     the data stream instead of relying on the loader's own auto-save
-    clock (which can drift from trainer steps)."""
+    clock (which can drift from trainer steps).
+
+    ``observer`` (obs/) carries the metrics registry, phase timing, and
+    sinks; built here from ``cfg`` (and ``model_cfg``, for the MFU FLOPs
+    model) when the entry point didn't pass one. The legacy wandb/aim
+    tracker attaches to it as one sink among several."""
     tracker_fn = get_tracker(cfg, rank)
+    from fms_fsdp_tpu.obs import build_observer
+    from fms_fsdp_tpu.obs.sinks import TrackerSink
+
+    if observer is None:
+        observer = build_observer(
+            cfg, rank, model_cfg=model_cfg, tracker_fn=tracker_fn
+        )
+    elif tracker_fn is not None:
+        observer.sinks.append(TrackerSink(tracker_fn))
 
     world_size = (
         jax.device_count()
@@ -281,13 +297,14 @@ def train(
             checkpointer,
             start_step,
             tokens_seen,
-            tracker_fn,
+            observer,
             world_size,
             dataloader,
         )
     finally:
         if profiler:
             profiler.close()
+        observer.close()
     return train_loss
 
 
@@ -301,11 +318,12 @@ def _train_loop(
     checkpointer,
     start_step,
     tokens_seen,
-    tracker_fn,
+    observer,
     world_size,
     dataloader=None,
 ):
     from fms_fsdp_tpu.resilience.guards import AnomalyGuard, StepWatchdog
+    from fms_fsdp_tpu.train.step import wrap_step_fn
 
     window = []
     train_loss = -1.0
@@ -319,7 +337,14 @@ def _train_loop(
     watchdog = None
     timeout_s = float(getattr(cfg, "step_timeout_s", 0.0) or 0.0)
     if timeout_s > 0:
-        watchdog = StepWatchdog(timeout_s).start()
+        hb = observer.heartbeat.path if observer.heartbeat else None
+        watchdog = StepWatchdog(timeout_s, heartbeat_path=hb).start()
+
+    # phase instrumentation: data_wait at the loop's next(), compute at
+    # step dispatch + the report-time fetch, checkpoint inside save()
+    train_loader = observer.wrap_data_iter(train_loader)
+    step_fn = wrap_step_fn(step_fn, observer.timer)
+    checkpointer.observer = observer
 
     try:
         for batch_idx, batch in enumerate(train_loader, start=start_step + 1):
@@ -339,7 +364,8 @@ def _train_loop(
                 # above only dispatches), so the watchdog timeout must
                 # cover a FULL report window of steps — see the
                 # step_timeout_s sizing note in config/training.py.
-                fetched = jax.device_get(window)
+                with observer.phase("compute"):
+                    fetched = jax.device_get(window)
                 if watchdog:
                     watchdog.beat()
                 window = []
@@ -348,7 +374,7 @@ def _train_loop(
                 # on device); report means over the clean steps only so
                 # one NaN doesn't poison the whole window's loss
                 flags = [float(m.pop("nonfinite", 0.0)) for m in fetched]
-                guard.observe(flags)
+                window_skips = guard.observe(flags)
                 good = [m for m, f in zip(fetched, flags) if not f] or fetched
                 train_loss = float(
                     sum(m["loss"] for m in good) / max(1, len(good))
@@ -370,20 +396,18 @@ def _train_loop(
                     * cfg.batch_size
                     * cfg.seq_length
                 )
+                total_tokens_seen = tokens_seen + new_tokens_seen
+                window_wall = time.time() - start
+                current_step_time = window_wall / cfg.report_interval
+                overall_step_time = elapsed_time / (batch_idx - start_step)
+                current_throughput = int(
+                    cfg.batch_size * cfg.seq_length / current_step_time
+                )
+                overall_throughput = int(
+                    cfg.batch_size * cfg.seq_length / overall_step_time
+                )
+                reserved_mem, allocated_mem = _memory_stats()
                 if rank == 0:
-                    total_tokens_seen = tokens_seen + new_tokens_seen
-                    current_step_time = (
-                        time.time() - start
-                    ) / cfg.report_interval
-                    overall_step_time = elapsed_time / (batch_idx - start_step)
-                    current_throughput = int(
-                        cfg.batch_size * cfg.seq_length / current_step_time
-                    )
-                    overall_throughput = int(
-                        cfg.batch_size * cfg.seq_length / overall_step_time
-                    )
-                    reserved_mem, allocated_mem = _memory_stats()
-
                     print("step:", batch_idx)
                     print("loss:", train_loss)
                     print("LR:", current_lr)
@@ -403,22 +427,36 @@ def _train_loop(
                         print("skipped batches:", guard.skipped_batches)
                     for k, v in extra_metrics.items():
                         print(f"{k}:", v)
-                    if tracker_fn:
-                        tracker_fn(
-                            {
-                                "learning rate": current_lr,
-                                "loss": train_loss,
-                                "gradient norm": g_norm,
-                                "token seen": total_tokens_seen,
-                                "current throughput (token per chip per sec)": current_throughput,
-                                "overall throughput (token per chip per sec)": overall_throughput,
-                                "chip reserved memory": reserved_mem,
-                                "chip allocated memory": allocated_mem,
-                                "skipped batches": guard.skipped_batches,
-                                **extra_metrics,
-                            },
-                            step=batch_idx,
-                        )
+                # structured record: every sink (JSONL/CSV file sinks,
+                # the legacy wandb/aim tracker adapter), goodput/MFU
+                # derivation, and the heartbeat hang off this one call;
+                # non-zero ranks run it too (no sinks — it closes their
+                # phase window so timing stays rank-consistent). Rates
+                # are derived from the window's TRUE step count (a
+                # resume's first window is partial — len(fetched) <
+                # report_interval — and the printed per-interval numbers
+                # inherit the reference's fixed divisor) so the
+                # persistent record never inflates throughput/MFU.
+                window_steps = max(1, len(fetched))
+                obs_step_time = max(1e-9, window_wall) / window_steps
+                observer.report(
+                    batch_idx,
+                    len(fetched),
+                    loss=train_loss,
+                    grad_norm=g_norm,
+                    learning_rate=current_lr,
+                    tokens_seen=total_tokens_seen,
+                    tokens_per_sec_per_chip=(
+                        cfg.batch_size * cfg.seq_length / obs_step_time
+                    ),
+                    tokens_per_sec_per_chip_overall=overall_throughput,
+                    step_time_s=obs_step_time,
+                    skipped_steps_total=guard.skipped_batches,
+                    skipped_steps_window=window_skips,
+                    memory_reserved_bytes=reserved_mem,
+                    memory_allocated_bytes=allocated_mem,
+                    extra=extra_metrics,
+                )
                 start = time.time()
 
                 if guard.should_abort():

@@ -129,8 +129,20 @@ def main(**kwargs):
 
     profiler = get_profiler(cfg, rank)
 
+    # observability (obs/): metrics registry + phase timing + JSONL/CSV
+    # sinks + heartbeat; built here so the feed can attribute its own
+    # pipeline/staging time into the same registry
+    from fms_fsdp_tpu.obs import build_observer
+
+    observer = build_observer(cfg, rank, model_cfg=model_cfg)
+
     # batch loop: stack per-rank batches to the local device batch
-    feed = DeviceFeed(rebatch(loader, local_batch, cfg.batch_size), mesh, prefetch=2)
+    feed = DeviceFeed(
+        rebatch(loader, local_batch, cfg.batch_size),
+        mesh,
+        prefetch=2,
+        registry=observer.registry,
+    )
 
     if rank == 0:
         print(f"Training for {cfg.num_steps} steps")
@@ -145,6 +157,8 @@ def main(**kwargs):
         start_step,
         tokens_seen,
         dataloader=ckpt_loader,
+        model_cfg=model_cfg,
+        observer=observer,
     )
 
 

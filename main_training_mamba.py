@@ -7,6 +7,10 @@ a MambaConfig and the train-step factory dispatches to the Mamba2 hybrid
 forward (models/mamba.py). No kernel cache management is needed: XLA/Mosaic
 compile caching is process-global.
 
+Observability (docs/observability.md) rides the shared orchestration:
+``--obs_dir=...`` emits the schema-versioned metrics.jsonl/heartbeat
+with Mamba-family MFU/HFU (utils/flops.py dispatches on MambaConfig).
+
 Run:  python main_training_mamba.py --use_dummy_dataset=True --num_steps=100
 """
 

@@ -8,6 +8,11 @@ Llama entry — ``get_model_config("mixtral_8x7b")`` returns a
 MixtralConfig and the train-step factory dispatches to the MoE forward
 with the load-balancing aux loss folded into the objective.
 
+Observability (docs/observability.md) rides the shared orchestration:
+``--obs_dir=...`` emits the schema-versioned metrics.jsonl/heartbeat;
+MoE MFU counts activated-expert FLOPs only (utils/flops.py) and the
+router's ``moe_drop_frac`` lands in each record's ``extra`` map.
+
 Run:  python main_training_mixtral.py --use_dummy_dataset=True \
           --expert_parallel_size=8 --num_steps=100
 """

@@ -158,8 +158,17 @@ def main(**kwargs):
         )
     else:
         train_loader = get_dummy_loader(cfg, rank, world_size)
+    # observability: same metrics.jsonl/heartbeat contract as the
+    # pretraining entries (docs/observability.md); MFU is null — the
+    # run's FLOPs are dominated by the frozen base, not the speculator
+    from fms_fsdp_tpu.obs import build_observer
+
+    observer = build_observer(cfg, rank)
     feed = DeviceFeed(
-        rebatch(train_loader, local_batch, cfg.batch_size), mesh, prefetch=2
+        rebatch(train_loader, local_batch, cfg.batch_size),
+        mesh,
+        prefetch=2,
+        registry=observer.registry,
     )
 
     optimizer = make_speculator_optimizer(cfg)
@@ -200,6 +209,7 @@ def main(**kwargs):
         ckpt_loader=ckpt_loader,
         base_api=base_api,
         mesh=mesh,
+        observer=observer,
     )
 
 
