@@ -1,0 +1,21 @@
+"""Async multi-tier checkpointing (docs/checkpointing.md).
+
+``AsyncCheckpointManager`` takes a blocking device→host snapshot at the
+step boundary and commits shards + loader state + manifest + metadata
+from a background writer thread, with at-most-one save in flight and a
+mandatory ``finalize()`` on loop exit. ``utils.checkpointing.
+Checkpointer`` remains as the synchronous compatibility layer (and the
+per-tier backend).
+"""
+
+from fms_fsdp_tpu.ckpt.manager import (
+    AsyncCheckpointManager,
+    CheckpointTier,
+    build_checkpoint_manager,
+)
+
+__all__ = [
+    "AsyncCheckpointManager",
+    "CheckpointTier",
+    "build_checkpoint_manager",
+]

@@ -1,0 +1,445 @@
+"""Async multi-tier checkpoint manager.
+
+``Checkpointer.save`` is fully synchronous: the train loop stalls on the
+entire shard write plus ``wait_until_finished()``, so every save charges
+its full storage latency against goodput. At preemption-heavy TPU scale
+the opposite is needed — frequent cheap saves — which this manager
+provides by splitting a save into two parts:
+
+- a **blocking snapshot** at the step boundary: the Orbax async save
+  call (returns once device arrays are copied to host) plus the loader
+  state capture. This is the only part on the critical path; its cost is
+  bounded by device→host bandwidth, not storage latency.
+- a **background commit** on a dedicated writer thread: wait for the
+  storage write to finish, then write the manifest and the
+  ``metadata.json`` commit marker (the same commit ordering as the sync
+  path: state shards → loader state → manifest → metadata), then run
+  the tier's retention GC.
+
+Concurrency contract:
+
+- **at most one save in flight** — ``save()`` first joins any running
+  writer (backpressure: a storage tier slower than the save cadence
+  throttles the loop instead of queueing unbounded snapshots);
+- **errors propagate** — a writer-thread failure is re-raised by the
+  *next* ``save()`` or by ``finalize()``; it is never swallowed;
+- **mandatory ``finalize()``** on loop exit/preemption — joins the
+  in-flight writer so the final save is never torn by process exit.
+
+Tiers (``CheckpointTier``): a *fast local* tier saved frequently with
+tight retention and a *durable* tier saved sparsely. Each tier is backed
+by its own ``Checkpointer`` (path layout, retention GC, manifest
+verification all reused); resume scans every tier and walks the merged
+candidate list newest-committed-first, reusing the manifest-verification
+fallback chain — a torn or corrupt newest candidate on one tier falls
+back to the next-newest committed checkpoint on *any* tier.
+
+Fault sites (resilience/faults.py): ``ckpt_writer_crash`` raises inside
+the writer thread (the error must surface in the next save/finalize);
+``ckpt_precommit_kill`` hard-exits the process between snapshot and
+commit marker (resume must fall back to the previous committed
+checkpoint).
+"""
+
+import json
+import os
+import threading
+import time
+from contextlib import nullcontext
+from typing import List, Optional
+
+import jax
+
+from fms_fsdp_tpu.utils.checkpointing import Checkpointer
+from fms_fsdp_tpu.utils.ckpt_paths import step_number
+
+
+class CheckpointTier:
+    """One storage destination: a name, a save cadence, and a retention
+    quota, backed by a ``Checkpointer`` owning the directory layout."""
+
+    def __init__(
+        self,
+        name: str,
+        root: str,
+        interval: int,
+        keep: int,
+        parallel_mode: str,
+        rank=None,
+        report_fn=None,
+        verify: bool = True,
+    ):
+        self.name = name
+        self.root = root
+        self.interval = int(interval)
+        self.ckp = Checkpointer(
+            root,
+            keep,
+            parallel_mode,
+            rank=rank,
+            report_fn=report_fn,
+            verify=verify,
+        )
+
+    def due(self, step: int) -> bool:
+        return self.interval > 0 and step % self.interval == 0
+
+
+class AsyncCheckpointManager:
+    """Multi-tier, async-commit checkpoint manager the train loops drive.
+
+    Drop-in for ``Checkpointer`` at the loop's three touchpoints —
+    ``save(step, state, dataloader, **metadata)``, ``load(...)`` (same
+    return tuple), and the ``observer`` attachment — plus ``save_due``
+    (tier cadence) and the mandatory ``finalize()``.
+    """
+
+    def __init__(
+        self,
+        tiers: List[CheckpointTier],
+        async_save: bool = True,
+        rank=None,
+    ):
+        assert tiers, "at least one (durable) tier is required"
+        self.tiers = tiers
+        # the durable tier is the last one by convention: it receives
+        # forced saves (final / preemption / abort / on-demand) and
+        # resolves external-path loads (continued pretraining)
+        self.durable = tiers[-1]
+        self.async_save = async_save
+        self.rank = jax.process_index() if rank is None else rank
+        self._observer = None
+        self._writer: Optional[threading.Thread] = None
+        self._writer_err: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        # background-write accounting drained by the Observer at report
+        # cadence (obs "checkpoint" phase covers only the blocking
+        # snapshot now; this is the off-critical-path remainder). The
+        # writer thread only touches these lock-protected cells — never
+        # the MetricRegistry, whose create-on-first-use dicts and
+        # histogram windows are main-thread-only by contract
+        # (obs/registry.py); obs_stats() flushes into the registry from
+        # the report call on the main thread.
+        self._bg_seconds = 0.0
+        self._in_flight = 0
+        self._pending_saves: list = []  # (tier_name, bytes, bg_s)
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def observer(self):
+        return self._observer
+
+    @observer.setter
+    def observer(self, obs):
+        # the train loop attaches its Observer here (same contract as
+        # Checkpointer.observer); the stats provider feeds the record's
+        # checkpoint_bg_s / checkpoint_in_flight fields
+        self._observer = obs
+        if obs is not None and hasattr(obs, "attach_checkpoint_stats"):
+            obs.attach_checkpoint_stats(self.obs_stats)
+
+    def obs_stats(self) -> dict:
+        """Drain the background-write window: seconds of writer-thread
+        wall time since the last report, and whether a save is in
+        flight right now. Called by Observer.report on the main thread
+        (before the registry snapshot), so the committed-save counters
+        accumulated by the writer flush into the registry here without
+        the writer ever touching registry structures."""
+        with self._lock:
+            bg_s, self._bg_seconds = self._bg_seconds, 0.0
+            done, self._pending_saves = self._pending_saves, []
+            in_flight = self._in_flight
+        obs = self._observer
+        if obs is not None:
+            for tier_name, nbytes, save_bg_s in done:
+                obs.registry.counter("checkpoint.saves").add()
+                obs.registry.counter(f"checkpoint.saves.{tier_name}").add()
+                if nbytes:
+                    obs.registry.counter("checkpoint.bytes").add(nbytes)
+                if save_bg_s is not None:
+                    obs.registry.hist("checkpoint.bg_write_s").record(
+                        save_bg_s
+                    )
+        return {"bg_s": bg_s, "in_flight": in_flight}
+
+    # -- save --------------------------------------------------------------
+
+    def save_due(self, step: int) -> bool:
+        """Any tier due at this step (the loop's interval check)."""
+        return any(t.due(step) for t in self.tiers)
+
+    def save(self, step, state, dataloader=None, reason="interval", **metadata):
+        """Blocking snapshot now; shard/manifest/marker commit in the
+        background. ``reason`` routes forced saves ("final", "preempt",
+        "abort", "demand") to the durable tier even off its cadence.
+
+        Raises any error recorded by the *previous* save's writer thread
+        (the failed save's step dir stays uncommitted and invisible to
+        every scanner)."""
+        obs = self._observer
+        with obs.phase("checkpoint") if obs is not None else nullcontext():
+            # backpressure join INSIDE the phase: when storage is
+            # slower than the save cadence, the main thread blocks
+            # right here — that stall is step-boundary checkpoint time
+            # and must be attributed as such, not vanish into "other"
+            self._join_writer()  # at most one save in flight
+            self._raise_pending()
+
+            due = [t for t in self.tiers if t.due(step)]
+            if reason != "interval" and self.durable not in due:
+                due.append(self.durable)
+            if not due:
+                due = [self.durable]
+            if self.durable in due:
+                # a durable-step save satisfies the local cadence too:
+                # the resume scan merges tiers, so a same-step local
+                # copy would only double the write volume
+                due = [self.durable]
+
+            snap_start = time.time()
+            jobs = []
+            for tier in due:
+                save_name = os.path.join(tier.ckp.ckp_path, f"step_{step}_ckp")
+                os.makedirs(save_name, exist_ok=True)
+                # Orbax StandardCheckpointer is async: save() returns
+                # once device arrays are snapshotted to host; the
+                # storage write proceeds on Orbax's own threads
+                tier.ckp._ckptr.save(
+                    os.path.join(save_name, "state"), state, force=True
+                )
+                if dataloader is not None:
+                    # loader state is host scalars/lists — captured at
+                    # the step boundary so it matches the model snapshot
+                    # exactly (a background capture would be torn
+                    # against a loader that kept advancing)
+                    dataloader.save_to_path(save_name)
+                jobs.append((tier, save_name))
+            if obs is not None:
+                obs.registry.hist("checkpoint.snapshot_s").record(
+                    time.time() - snap_start
+                )
+
+            meta = dict(metadata)
+            meta["step"] = step
+            with self._lock:
+                self._in_flight = 1
+            if self.async_save:
+                self._writer = threading.Thread(
+                    target=self._commit_job,
+                    args=(jobs, step, meta),
+                    name="ckpt-writer",
+                    daemon=True,
+                )
+                self._writer.start()
+            else:
+                # synchronous mode: the storage wait + commit runs here
+                # on the main thread — it IS the critical path, so it
+                # stays inside the "checkpoint" phase (the schema
+                # contract: checkpoint_s is the whole save when
+                # ckpt_async=False) and contributes nothing to the
+                # background accounting
+                self._commit_job(jobs, step, meta, background=False)
+                self._raise_pending()
+
+    def _commit_job(self, jobs, step, meta, background=True):
+        """Writer body: wait out the storage write, then commit
+        (manifest → metadata marker), GC the tier, account the time."""
+        from fms_fsdp_tpu.resilience.faults import fire_fault, maybe_raise_fault
+        from fms_fsdp_tpu.resilience.integrity import write_manifest
+
+        bg_start = time.time()
+        try:
+            for tier, save_name in jobs:
+                tier.ckp._ckptr.wait_until_finished()
+                # writer-thread crash site: the error must surface in
+                # the NEXT save()/finalize(), never vanish
+                maybe_raise_fault(
+                    "ckpt_writer_crash",
+                    exc_cls=RuntimeError,
+                    step=step,
+                    tier=tier.name,
+                )
+                if self.rank == 0:
+                    write_manifest(save_name)
+                    # kill window between snapshot and commit marker:
+                    # the dir is fully written but uncommitted — resume
+                    # must skip it and fall back
+                    params = fire_fault(
+                        "ckpt_precommit_kill", step=step, tier=tier.name
+                    )
+                    if params is not None:
+                        os._exit(int(params.get("code", 1)))
+                    meta_path = os.path.join(save_name, "metadata.json")
+                    with open(meta_path + ".tmp", "w") as f:
+                        json.dump(meta, f)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(meta_path + ".tmp", meta_path)
+                    Checkpointer._maybe_corrupt(save_name, step, tier=tier.name)
+                nbytes = _dir_bytes(save_name) if self.rank == 0 else 0
+                if self._observer is not None:
+                    # flushed into the registry by obs_stats() on the
+                    # main thread at report cadence; bg duration is None
+                    # for synchronous commits (their wall time is the
+                    # checkpoint phase, not background write). Without
+                    # an observer there is no drain cadence, so nothing
+                    # is queued (the list must not grow unbounded).
+                    with self._lock:
+                        self._pending_saves.append(
+                            (
+                                tier.name,
+                                nbytes,
+                                (time.time() - bg_start)
+                                if background
+                                else None,
+                            )
+                        )
+                tier.ckp.report(
+                    f"Checkpoint saved in {save_name}",
+                    model_save_time=time.time() - bg_start,
+                )
+                tier.ckp._cleanup()
+        except BaseException as e:  # noqa: BLE001 — recorded, re-raised
+            # by the next save()/finalize(); a writer error silently
+            # dropped would let the run believe it is checkpointed
+            with self._lock:
+                self._writer_err = e
+        finally:
+            with self._lock:
+                if background:
+                    self._bg_seconds += time.time() - bg_start
+                self._in_flight = 0
+
+    def _join_writer(self):
+        w = self._writer
+        if w is not None and w is not threading.current_thread():
+            w.join()
+            self._writer = None
+
+    def _raise_pending(self):
+        with self._lock:
+            err, self._writer_err = self._writer_err, None
+        if err is not None:
+            raise RuntimeError(
+                "background checkpoint writer failed; the affected save "
+                "is uncommitted (resume falls back to the previous "
+                "committed checkpoint)"
+            ) from err
+
+    def finalize(self):
+        """Join the in-flight writer and surface any writer error.
+        MANDATORY on loop exit/preemption: returning from the loop with
+        a save still in flight would tear the final checkpoint when the
+        process exits."""
+        self._join_writer()
+        self._raise_pending()
+
+    # -- load --------------------------------------------------------------
+
+    def load(self, state, dataloader=None, path="", reset_stepcount=False,
+             strict=True):
+        """Resume from the newest committed checkpoint across all tiers
+        (merged candidate list, newest step first, manifest-verified
+        fallback down the chain); if no tier holds one, fall through to
+        ``path`` (continued pretraining) via the durable tier."""
+        lead = self.durable.ckp
+        candidates = []
+        for tier in self.tiers:
+            candidates.extend(tier.ckp._candidate_ckp_paths(tier.ckp.ckp_path))
+        # tier saves are always step dirs; order strictly by step number
+        # so "newest committed" is global across tiers, not per-tier
+        candidates.sort(key=step_number, reverse=True)
+        is_resuming = bool(candidates)
+        if jax.process_count() > 1:
+            # one authoritative scan (rank 0) across tiers: every host
+            # must walk the same merged list in the same order
+            decision = lead._broadcast_obj(
+                {"resume": is_resuming, "cands": candidates}
+            )
+            is_resuming = bool(decision["resume"])
+            candidates = [str(c) for c in decision["cands"]]
+        if not is_resuming:
+            return lead.load(
+                state,
+                dataloader,
+                path=path,
+                reset_stepcount=reset_stepcount,
+                strict=strict,
+            )
+        return lead.load(
+            state,
+            dataloader,
+            path=self.durable.root,
+            reset_stepcount=reset_stepcount,
+            strict=strict,
+            candidates=candidates,
+            is_resuming=True,
+        )
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total
+
+
+def build_checkpoint_manager(
+    cfg, rank=None, parallel_mode=None, report_fn=None
+) -> AsyncCheckpointManager:
+    """Manager from TrainConfig knobs (docs/checkpointing.md): the
+    durable tier at ``ckpt_save_path`` on the ``checkpoint_interval``
+    cadence, plus an optional fast local tier (``ckpt_local_dir`` +
+    ``ckpt_local_interval``) with tight retention."""
+    mode = parallel_mode or cfg.sharding_strategy
+    verify = bool(getattr(cfg, "checkpoint_verify", True))
+    tiers = []
+    local_dir = getattr(cfg, "ckpt_local_dir", "") or ""
+    local_interval = int(getattr(cfg, "ckpt_local_interval", 0) or 0)
+    if local_dir and local_interval > 0 and jax.process_count() > 1:
+        # sharded writes + rank-0-only commit/GC assume every process
+        # sees the tier's directory: a host-local path would leave
+        # hosts >= 1 with uncommitted, never-collected dirs and a
+        # resume unable to assemble the full state
+        if (jax.process_index() if rank is None else rank) == 0:
+            print(
+                "WARNING: ckpt_local_dir on a multi-process world must "
+                "be a SHARED filesystem visible to every host "
+                "(docs/checkpointing.md); a host-local path will leak "
+                "uncommitted checkpoint dirs and break resume."
+            )
+    if local_dir and local_interval > 0:
+        tiers.append(
+            CheckpointTier(
+                "local",
+                local_dir,
+                local_interval,
+                int(getattr(cfg, "ckpt_local_keep", 2)),
+                mode,
+                rank=rank,
+                report_fn=report_fn,
+                verify=verify,
+            )
+        )
+    tiers.append(
+        CheckpointTier(
+            "durable",
+            cfg.ckpt_save_path,
+            int(cfg.checkpoint_interval),
+            int(getattr(cfg, "ckpt_keep", 1000)),
+            mode,
+            rank=rank,
+            report_fn=report_fn,
+            verify=verify,
+        )
+    )
+    return AsyncCheckpointManager(
+        tiers,
+        async_save=bool(getattr(cfg, "ckpt_async", True)),
+        rank=rank,
+    )

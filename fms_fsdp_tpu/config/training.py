@@ -112,6 +112,21 @@ class TrainConfig:
     checkpoint_verify: bool = True  # verify manifests on load, fall back on corruption
     faults: str = ""  # fault-injection spec (testing only; see resilience/faults.py)
 
+    # checkpointing (docs/checkpointing.md). The async manager snapshots
+    # device state at the step boundary (blocking) and commits shards +
+    # loader state + manifest + metadata from a background writer thread
+    # — at most one save in flight, errors surfacing in the next save or
+    # finalize(). The durable tier lives at ckpt_save_path on the
+    # checkpoint_interval cadence; the optional fast local tier (local
+    # SSD/ramdisk) saves frequently with tight retention so a preempted
+    # worker restarts from minutes-old state instead of the last durable
+    # save.
+    ckpt_async: bool = True  # background commit (False = legacy synchronous save)
+    ckpt_keep: int = 1000  # durable-tier retention (rolling, by step number)
+    ckpt_local_dir: str = ""  # fast-tier root; "" disables the local tier
+    ckpt_local_interval: int = 0  # steps between local-tier saves; 0 disables
+    ckpt_local_keep: int = 2  # local-tier retention
+
     # profiling
     use_profiler: bool = False
     profiler_rank0_only: bool = True

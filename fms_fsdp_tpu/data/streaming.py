@@ -95,9 +95,14 @@ class StreamingDocDataset(StatefulDataset):
         # rediscover the same bad file the hard way. Shards unreadable at
         # SETUP (length probe failed; zero-doc span for the whole run)
         # are tracked separately so the epoch-boundary re-probe doesn't
-        # pointlessly clear them — setup() rebuilds that set on resume.
+        # pointlessly clear them — AND persisted in the state_dict: the
+        # docset is built around their zero-doc spans, so a resume on a
+        # healed shard must re-apply the set before rebuilding the
+        # docset, or the restored docset_index/lcg_state would walk a
+        # silently shifted document order (replays/skips for the rest of
+        # the epoch).
         self.quarantined_shards: List[str] = []
-        self._setup_quarantined: Set[str] = set()
+        self.setup_quarantined: List[str] = []
 
         self.state_params = [
             "dataset",
@@ -109,6 +114,7 @@ class StreamingDocDataset(StatefulDataset):
             "percent_seen",
             "lcg_state",
             "quarantined_shards",
+            "setup_quarantined",
         ]
 
         self.is_setup = False
@@ -158,7 +164,8 @@ class StreamingDocDataset(StatefulDataset):
                 # quarantine and contribute zero docs — the run starts on
                 # the readable shards instead of dying in setup
                 self._quarantine(shard, e)
-                self._setup_quarantined.add(shard)
+                if shard not in self.setup_quarantined:
+                    self.setup_quarantined.append(shard)
                 doc_counts[shard] = 0
         return doc_counts
 
@@ -166,6 +173,16 @@ class StreamingDocDataset(StatefulDataset):
         if self.is_setup:
             return
         super().setup()
+        self._build_docset()
+        self.lcg_state = self.seed + self.rank
+
+    def _build_docset(self):
+        """(Re)build the owned docset spans. Shards listed in
+        ``setup_quarantined`` are forced to zero docs even when their
+        length probe succeeds now — called once at setup, and again on
+        resume when the checkpoint carries setup-quarantined shards that
+        have healed since (the restored walk position is only valid over
+        the docset it was saved against)."""
         # dataset name = final path component (robust to trailing slashes)
         pathsplit = (self.datapath, "")
         while len(pathsplit[1]) == 0:
@@ -184,6 +201,11 @@ class StreamingDocDataset(StatefulDataset):
         ]
 
         doc_counts = self._load_doc_counts(pardir, dataset, shardfrags)
+        # setup-time quarantine (this run's probe failures plus any
+        # persisted from the checkpoint): zero-doc spans, always
+        for shard in self.setup_quarantined:
+            if shard in doc_counts:
+                doc_counts[shard] = 0
 
         # Aggregate owned fragments into per-shard [min, max] doc spans.
         spans = {}
@@ -197,6 +219,7 @@ class StreamingDocDataset(StatefulDataset):
                 spans[shard][0] = min(spans[shard][0], doc_start)
                 spans[shard][1] = max(spans[shard][1], doc_end)
 
+        self.docset = []
         doccount = 0
         for shardid, (min_d, max_d) in spans.items():
             self.docset.append((shardid, min_d, max_d))
@@ -209,10 +232,8 @@ class StreamingDocDataset(StatefulDataset):
                 f"fragments from {dataset}"
             )
 
-        # Shard-file order shuffle + doc-shuffle seed, distinct per worker.
-        seed = self.seed + self.rank
-        random.Random(seed).shuffle(self.docset)
-        self.lcg_state = seed
+        # Shard-file order shuffle, distinct per worker.
+        random.Random(self.seed + self.rank).shuffle(self.docset)
 
     # -- doc addressing ---------------------------------------------------
 
@@ -334,7 +355,7 @@ class StreamingDocDataset(StatefulDataset):
                 self.quarantined_shards = [
                     s
                     for s in self.quarantined_shards
-                    if s in self._setup_quarantined
+                    if s in self.setup_quarantined
                 ]
             first_pass = False
             for i in range(ndocs):
@@ -411,10 +432,60 @@ class StreamingDocDataset(StatefulDataset):
             "Please use a ScalableShardDataset."
         )
         d = self.dataset
+        # this run's own setup-time probe failures, before the restored
+        # state overwrites the attribute
+        own_setup_q = set(self.setup_quarantined)
         out = super().load_state_dict(state_dicts, sharded_input)
         assert d == self.dataset, (
             f"Dataset mismatch: checkpoint contains {self.dataset}, expected {d}"
         )
+        # the restored state replaced both quarantine lists wholesale;
+        # THIS run's own setup-probe failures must merge back in (the
+        # live docset already zeroes them, and dropping them here would
+        # persist a checkpoint without them — re-creating the shifted-
+        # walk bug one save later, when that checkpoint is resumed on a
+        # healed shard)
+        ckpt_setup_q = set(self.setup_quarantined)
+        merged = own_setup_q | ckpt_setup_q
+        ckpt_added = merged - own_setup_q
+        newly_broken = own_setup_q - ckpt_setup_q
+        self.setup_quarantined = sorted(merged)
+        for s in self.setup_quarantined:
+            if s not in self.quarantined_shards:
+                self.quarantined_shards.append(s)
+        if newly_broken:
+            # the reverse direction is NOT fixable: these shards held
+            # readable docs when the checkpoint was written, and this
+            # run cannot serve them — the restored docset_index/
+            # lcg_state index a shrunk docset, so the walk position is
+            # approximate (documents near the boundary may replay or
+            # skip for the rest of the epoch). Say so loudly instead of
+            # resuming as if nothing changed.
+            logger.warning(
+                "Worker %d: %d shard(s) readable at checkpoint time "
+                "failed this run's setup probe (%s); their documents "
+                "are unavailable and the restored stream position is "
+                "approximate for the rest of the epoch",
+                self.rank,
+                len(newly_broken),
+                sorted(newly_broken),
+            )
+        if ckpt_added:
+            # the checkpoint carries setup-quarantined shards this run's
+            # probe succeeded on (healed since the save): the saved
+            # docset_index/lcg_state walk a docset where those shards
+            # had zero docs, so rebuild ours to match — a heal must wait
+            # for the natural epoch boundary, not shift the walk under a
+            # restored position. (Own-only shards need no rebuild: the
+            # docset built at setup already zeroes them.)
+            logger.info(
+                "Worker %d re-applying %d setup-quarantined shard(s) from "
+                "the checkpoint before the docset rebuild: %s",
+                self.rank,
+                len(ckpt_added),
+                sorted(ckpt_added),
+            )
+            self._build_docset()
         return out
 
 

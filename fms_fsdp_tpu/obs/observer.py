@@ -54,6 +54,14 @@ class Observer:
         self.strict_schema = strict_schema
         self.last_record: Optional[Dict] = None
         self._schema_warned = False
+        # set by the async checkpoint manager (ckpt/manager.py) when the
+        # loop attaches this observer to it: a callable draining the
+        # background-write window ({bg_s, in_flight}) for the record's
+        # checkpoint_bg_s / checkpoint_in_flight fields
+        self._ckpt_stats: Optional[Callable[[], Dict]] = None
+
+    def attach_checkpoint_stats(self, fn: Callable[[], Dict]) -> None:
+        self._ckpt_stats = fn
 
     # -- hot-loop hooks ----------------------------------------------------
 
@@ -109,6 +117,11 @@ class Observer:
                     * self.hfu_flops_per_token
                     / self.peak_flops
                 )
+        # checkpoint stats BEFORE the registry snapshot: the provider
+        # (ckpt/manager.py obs_stats) flushes the writer thread's
+        # committed-save counters into the registry here on the main
+        # thread, so they land in THIS record's extras
+        ckpt_stats = self._ckpt_stats() if self._ckpt_stats else {}
         extras = dict(self.registry.snapshot())
         if extra:
             extras.update(extra)
@@ -139,7 +152,12 @@ class Observer:
                 window["data_wait"] / wall if wall > 0 else 0.0
             ),
             "compute_s": window["compute"],
+            # blocking time at the step boundary only (the snapshot,
+            # under the async manager); the storage-write remainder is
+            # checkpoint_bg_s, off the critical path
             "checkpoint_s": window["checkpoint"],
+            "checkpoint_bg_s": float(ckpt_stats.get("bg_s", 0.0)),
+            "checkpoint_in_flight": int(ckpt_stats.get("in_flight", 0)),
             "wall_s": wall,
             "goodput": goodput_w,
             "goodput_overall": goodput_all,

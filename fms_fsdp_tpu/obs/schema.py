@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -40,7 +40,17 @@ SCHEMA_FIELDS = {
     "data_wait_s": ("float", True),
     "data_wait_frac": ("float", True),
     "compute_s": ("float", True),
+    # v2: checkpoint_s is the step-boundary BLOCKING time only (the
+    # device→host snapshot under the async manager; the whole save when
+    # running synchronously)...
     "checkpoint_s": ("float", True),
+    # ...and checkpoint_bg_s is the background writer-thread wall time
+    # that landed in this window (off the critical path), with
+    # checkpoint_in_flight flagging a save still committing at report
+    # time. Per-tier save counts and bytes ride in ``extra``
+    # (checkpoint.saves.<tier>, checkpoint.bytes).
+    "checkpoint_bg_s": ("float", True),
+    "checkpoint_in_flight": ("int", True),
     "wall_s": ("float", True),
     "goodput": ("float", True),
     "goodput_overall": ("float", False),
@@ -56,6 +66,9 @@ SCHEMA_FIELDS = {
 # without a version bump.
 SCHEMA_DIGESTS = {
     1: "01cf2035086946667a852893e38535f44bd340e20871a10be2d6f4103cd62f90",
+    # v2: + checkpoint_bg_s / checkpoint_in_flight (async checkpoint
+    # manager: blocking-snapshot vs background-write split)
+    2: "6fe196571d7fdf02da2dc0060f5151ddbcee7fae5275ad45277c0bce95be49c8",
 }
 
 

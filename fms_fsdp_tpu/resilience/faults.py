@@ -17,8 +17,19 @@ loader_worker   StatefulDataLoader worker loops (thread + process),
 nan_loss        inside the jitted train step (multiplies loss and grads
                 by NaN for the matching step window) — consulted once
                 at trace time via :func:`fault_params`
-ckpt_corrupt    Checkpointer.save, after the commit marker is written
-                (truncates one file inside the committed checkpoint)
+ckpt_corrupt    Checkpointer.save / the async writer thread, after the
+                commit marker is written (truncates one file inside the
+                committed checkpoint)
+ckpt_writer_crash
+                AsyncCheckpointManager's background writer thread,
+                after the storage write and before commit (raises
+                RuntimeError in the writer; the error must surface in
+                the next ``save``/``finalize``)
+ckpt_precommit_kill
+                AsyncCheckpointManager's writer, between the snapshot
+                (fully written dir) and the metadata.json commit marker
+                (hard-exits the process with ``code``, default 1) — the
+                mid-save kill whose torn dir resume must skip
 ==============  =======================================================
 
 Spec strings configure the registry, via the ``FMS_FAULTS`` environment
@@ -28,8 +39,8 @@ variable or ``TrainConfig.faults``::
     e.g.  "shard_read:path=quartershard:times=2;nan_loss:step=5:count=3"
 
 Filter params are matched against the call-site context before firing:
-``path`` / ``op`` (substring), ``worker`` / ``batch`` / ``step``
-(equality). A configured filter the call site does not supply in its
+``path`` / ``op`` / ``tier`` (substring), ``worker`` / ``batch`` /
+``step`` (equality). A configured filter the call site does not supply in its
 context is a non-match (the fault does not fire) — a typo'd filter must
 never degrade into firing everywhere.
 ``times=N`` caps the number of fires (per process; counters are
@@ -51,7 +62,7 @@ _FIRED: Dict[str, int] = {}
 ENV_VAR = "FMS_FAULTS"
 
 # params that filter whether a call-site context matches (vs payload)
-_FILTER_KEYS = ("path", "op", "worker", "batch", "step")
+_FILTER_KEYS = ("path", "op", "worker", "batch", "step", "tier")
 
 
 def _parse_value(v: str):

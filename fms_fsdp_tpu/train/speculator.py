@@ -359,16 +359,32 @@ def train_speculator(
                 start = time.time()
 
             preempt_now = preemption.poll()
+            interval_due = (
+                checkpointer.save_due(batch_idx)
+                if hasattr(checkpointer, "save_due")
+                else batch_idx % cfg.checkpoint_interval == 0
+            )
+            demand_now = do_ckpt(cfg.ckpt_save_path) is True
             if (
-                batch_idx % cfg.checkpoint_interval == 0
+                interval_due
                 or batch_idx == cfg.num_steps
-                or do_ckpt(cfg.ckpt_save_path) is True
+                or demand_now
                 or preempt_now
             ):
+                reason = (
+                    "preempt"
+                    if preempt_now
+                    else "final"
+                    if batch_idx == cfg.num_steps
+                    else "demand"
+                    if demand_now
+                    else "interval"
+                )
                 checkpointer.save(
                     batch_idx,
                     spec_state,
                     ckpt_loader,
+                    reason=reason,
                     tokens_seen=elapsed_tokens + n_tok,
                 )
                 do_ckpt(cfg.ckpt_save_path, reset=True)
@@ -380,5 +396,11 @@ def train_speculator(
                     )
                 break
     finally:
-        observer.close()
+        try:
+            # never exit with a save in flight: the final/preemption
+            # checkpoint must be committed, not torn (ckpt/manager.py;
+            # no-op on the synchronous Checkpointer)
+            checkpointer.finalize()
+        finally:
+            observer.close()
     return spec_state

@@ -313,43 +313,75 @@ class Checkpointer:
                 ckp_to_remove.unlink()
             else:
                 shutil.rmtree(ckp_to_remove)
-        # loader-only auto-save dirs: CheckpointDataset resumes from the
-        # newest of them only, so keep the newest two (margin for a
-        # partially-written newest) and drop the rest. Ranked strictly
-        # among loader-only dirs — their step numbers are on the worker
-        # clock, which can lag or lead the trainer clock, so comparing
-        # them against model-checkpoint numbers would be meaningless (and
-        # at worst delete the only loader state).
+        # non-model step dirs split two ways:
+        # - loader-only auto-save dirs (loader_state files, no model
+        #   state payload): CheckpointDataset resumes from the newest of
+        #   them only, so keep the newest two (margin for a partially-
+        #   written newest) and drop the rest. Ranked strictly among
+        #   loader-only dirs — their step numbers are on the worker
+        #   clock, which can lag or lead the trainer clock, so comparing
+        #   them against model-checkpoint numbers would be meaningless
+        #   (and at worst delete the only loader state).
+        # - torn (uncommitted) model saves: state payload or manifest
+        #   but no metadata.json commit marker — a save killed before
+        #   commit. Invisible to every scanner and to the retention
+        #   quota, so without GC they accumulate forever; ALL of them
+        #   are prune candidates (after the same quiesce window, which
+        #   spares a save still being written).
+        def has_loader_state(p):
+            return any(
+                f.startswith("loader_state") for f in safe_listdir(p)
+            )
+
+        def has_state_payload(p):
+            # a committed/in-flight orbax write ("state", or its tmp
+            # name mid-write) or the manifest written just before commit
+            return any(
+                f == "state" or "orbax-checkpoint" in f or f == "manifest.json"
+                for f in safe_listdir(p)
+            )
+
+        non_model = [
+            os.path.join(self.ckp_path, x)
+            for x in os.listdir(self.ckp_path)
+            if is_step_ckp(x)
+            and not is_model_ckp(os.path.join(self.ckp_path, x))
+        ]
         loader_only = sorted(
             (
-                os.path.join(self.ckp_path, x)
-                for x in os.listdir(self.ckp_path)
-                if is_step_ckp(x)
-                and not is_model_ckp(os.path.join(self.ckp_path, x))
+                p
+                for p in non_model
+                if has_loader_state(p) and not has_state_payload(p)
             ),
             key=step_number,
             reverse=True,
         )
+        torn = [p for p in non_model if p not in loader_only]
         def newest_mtime(p):
-            # mtime fingerprint across the dir and its files: a growing
-            # loader_state file bumps its own mtime, not the directory's.
-            # A full fingerprint (not max): a skewed writer can stamp a
-            # file BELOW the directory mtime, which a max would never see
+            # mtime fingerprint across the dir tree: a growing
+            # loader_state file (or a TensorStore shard deep inside a
+            # torn dir's state payload) bumps its own mtime, not the
+            # directory's. A full fingerprint (not max): a skewed writer
+            # can stamp a file BELOW the directory mtime, which a max
+            # would never see
             try:
-                return tuple(
-                    [("", os.path.getmtime(p))]
-                    + sorted(
-                        (f, os.path.getmtime(os.path.join(p, f)))
-                        for f in safe_listdir(p)
-                    )
-                )
+                entries = [("", os.path.getmtime(p))]
+                for root, _, files in os.walk(p):
+                    for f in files:
+                        full = os.path.join(root, f)
+                        entries.append(
+                            (os.path.relpath(full, p), os.path.getmtime(full))
+                        )
+                return tuple(sorted(entries))
             except OSError:
                 return None
 
         # a straggler worker can still be writing its shard into an old
-        # step dir (its auto-save clock lags the fast workers'): prune a
-        # candidate only after its newest mtime holds STILL across two
-        # cleanup passes at least PRUNE_QUIESCE_S of local time apart.
+        # step dir (its auto-save clock lags the fast workers'), and an
+        # async save's storage write may still be landing in a dir that
+        # looks torn until its commit marker appears: prune a candidate
+        # only after its newest mtime holds STILL across two cleanup
+        # passes at least PRUNE_QUIESCE_S of local time apart.
         # Progress is detected by mtime CHANGE, never by comparing an
         # mtime against the local clock — shared-storage server clocks
         # can lead or lag rank 0's by more than the window, which would
@@ -357,7 +389,7 @@ class Checkpointer:
         # never prune at all).
         now = time.time()
         marks = self._prune_marks
-        candidates = {p: newest_mtime(p) for p in loader_only[2:]}
+        candidates = {p: newest_mtime(p) for p in loader_only[2:] + torn}
         for p, m in candidates.items():
             if m is None:
                 marks.pop(p, None)
@@ -378,10 +410,13 @@ class Checkpointer:
 
     # -- save ---------------------------------------------------------------
 
-    def save(self, step, state, dataloader=None, **metadata):
+    def save(self, step, state, dataloader=None, reason="interval", **metadata):
         """Write the sharded train state + loader state + metadata to
         ``step_<step>_ckp``. ``metadata`` kwargs (e.g. tokens_seen) land in
-        metadata.json with the step count.
+        metadata.json with the step count. ``reason`` is accepted for
+        call-compatibility with the tiered AsyncCheckpointManager (the
+        loop passes it unconditionally); the synchronous path has no
+        tier routing, so it is ignored.
 
         Commit ordering: state shards -> loader state -> manifest ->
         metadata.json (the commit marker, atomic rename). A save torn
@@ -424,15 +459,21 @@ class Checkpointer:
         )
         return self._cleanup()
 
+    def finalize(self):
+        """No-op: the synchronous save has nothing in flight when it
+        returns. Lets callers invoke ``finalize()`` unconditionally at
+        loop exit (the async manager's is mandatory)."""
+
     @staticmethod
-    def _maybe_corrupt(save_name, step):
+    def _maybe_corrupt(save_name, step, **ctx):
         """``ckpt_corrupt`` fault site: truncate one file inside the
         just-committed checkpoint (``file=<substring>`` selects it) —
         the torn/bit-rotted storage failure the load-time manifest
-        verification and fallback chain must absorb."""
+        verification and fallback chain must absorb. Extra ``ctx``
+        (e.g. ``tier`` from the async writer) feeds the fault filters."""
         from fms_fsdp_tpu.resilience.faults import fire_fault
 
-        params = fire_fault("ckpt_corrupt", step=step)
+        params = fire_fault("ckpt_corrupt", step=step, **ctx)
         if params is None:
             return
         want = str(params.get("file", ""))
@@ -459,6 +500,8 @@ class Checkpointer:
         path="",
         reset_stepcount=False,
         strict=True,
+        candidates=None,
+        is_resuming=None,
     ):
         """Restore (state, dataloader) from the save dir if it holds a
         checkpoint (job restart), else from ``path``.
@@ -466,6 +509,12 @@ class Checkpointer:
         ``state`` is the freshly initialized sharded train state — it
         provides the target structure/sharding for restoration. Returns
         (state, dataloader, step, tokens_seen, is_resuming).
+
+        ``candidates`` (with ``is_resuming``) lets a caller that already
+        scanned — the tiered AsyncCheckpointManager merging several
+        checkpoint roots — inject its own newest-first candidate list;
+        the caller is then responsible for the multi-host agreement on
+        that list (the broadcast below is skipped).
 
         Integrity: each candidate checkpoint is manifest-verified (when
         ``self.verify``) and its restore wrapped — a corrupt or torn
@@ -475,24 +524,27 @@ class Checkpointer:
         scratch silently would be worse than crashing)."""
         from fms_fsdp_tpu.resilience.integrity import verify_manifest
 
-        is_resuming = False
-        candidates = self._candidate_ckp_paths(self.ckp_path)
-        if candidates:
-            path = self.ckp_path
-            is_resuming = True
+        if candidates is None:
+            is_resuming = False
+            candidates = self._candidate_ckp_paths(self.ckp_path)
+            if candidates:
+                path = self.ckp_path
+                is_resuming = True
+            else:
+                candidates = self._candidate_ckp_paths(path)
+            if jax.process_count() > 1:
+                # process 0's directory scan is authoritative: eventually-
+                # consistent shared storage can show hosts different
+                # listings, and every host must walk the SAME candidate
+                # list in the same order — the per-candidate votes and
+                # collective restores below are counted in lockstep
+                decision = self._broadcast_obj(
+                    {"resume": is_resuming, "cands": candidates}
+                )
+                is_resuming = bool(decision["resume"])
+                candidates = [str(c) for c in decision["cands"]]
         else:
-            candidates = self._candidate_ckp_paths(path)
-        if jax.process_count() > 1:
-            # process 0's directory scan is authoritative: eventually-
-            # consistent shared storage can show hosts different listings,
-            # and every host must walk the SAME candidate list in the same
-            # order — the per-candidate votes and collective restores
-            # below are counted in lockstep
-            decision = self._broadcast_obj(
-                {"resume": is_resuming, "cands": candidates}
-            )
-            is_resuming = bool(decision["resume"])
-            candidates = [str(c) for c in decision["cands"]]
+            is_resuming = bool(is_resuming)
         if not candidates:
             self.report(
                 f"No valid checkpoint detected at {path}, starting from scratch."

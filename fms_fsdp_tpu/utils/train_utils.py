@@ -304,7 +304,15 @@ def train(
     finally:
         if profiler:
             profiler.close()
-        observer.close()
+        try:
+            # mandatory on loop exit/preemption (ckpt/manager.py):
+            # joins the in-flight background writer so the final save
+            # is never torn by process exit, and surfaces any writer
+            # error the loop hadn't hit yet (no-op on the synchronous
+            # Checkpointer)
+            checkpointer.finalize()
+        finally:
+            observer.close()
     return train_loss
 
 
@@ -469,6 +477,7 @@ def _train_loop(
                             batch_idx,
                             state,
                             dataloader,
+                            reason="abort",
                             tokens_seen=tokens_seen + new_tokens_seen,
                         )
                     raise RuntimeError(
@@ -479,19 +488,31 @@ def _train_loop(
                     )
 
             preempt_now = preemption.poll()
-            if (
-                batch_idx % cfg.checkpoint_interval == 0
-                or batch_idx == cfg.num_steps
-                or preempt_now
-            ):
+            # tier-aware cadence when the checkpointer is the async
+            # manager (a fast local tier can be due between durable
+            # intervals); plain Checkpointer keeps the single interval
+            interval_due = (
+                checkpointer.save_due(batch_idx)
+                if hasattr(checkpointer, "save_due")
+                else batch_idx % cfg.checkpoint_interval == 0
+            )
+            if interval_due or batch_idx == cfg.num_steps or preempt_now:
                 # the watchdog deadline is sized for step windows; a
                 # healthy multi-minute Orbax save must not trip it, so
-                # the watchdog is suspended (and re-armed) around it
+                # the watchdog is suspended (and re-armed) around it.
+                # (Async saves only block for the snapshot here; the
+                # storage write runs on the background writer.)
+                reason = (
+                    "preempt"
+                    if preempt_now
+                    else ("final" if batch_idx == cfg.num_steps else "interval")
+                )
                 with watchdog.paused() if watchdog else _nullctx():
                     checkpointer.save(
                         batch_idx,
                         state,
                         dataloader,
+                        reason=reason,
                         tokens_seen=tokens_seen + new_tokens_seen,
                     )
             if preempt_now:

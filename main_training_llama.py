@@ -29,7 +29,7 @@ from fms_fsdp_tpu.train.step import (
     make_optimizer,
     make_train_step,
 )
-from fms_fsdp_tpu.utils.checkpointing import Checkpointer
+from fms_fsdp_tpu.ckpt import build_checkpoint_manager
 from fms_fsdp_tpu.utils.cli import parse_cli_args
 from fms_fsdp_tpu.utils.config_utils import get_model_config, update_config
 from fms_fsdp_tpu.utils.train_utils import (
@@ -103,15 +103,11 @@ def main(**kwargs):
         jax.random.PRNGKey(cfg.seed), model_cfg, cfg, mesh, optimizer
     )
 
-    # checkpoint load (continued pretraining or job restart)
-    checkpointer = Checkpointer(
-        cfg.ckpt_save_path,
-        1000,
-        cfg.sharding_strategy,
-        rank,
-        0,
-        verify=cfg.checkpoint_verify,
-    )
+    # checkpoint load (continued pretraining or job restart): the async
+    # multi-tier manager (ckpt/) — blocking snapshot at the step
+    # boundary, shard/manifest/commit on a background writer, optional
+    # fast local tier alongside the durable one (docs/checkpointing.md)
+    checkpointer = build_checkpoint_manager(cfg, rank)
     state, _, start_step, tokens_seen, is_resuming = checkpointer.load(
         state,
         None,

@@ -10,6 +10,9 @@ compile caching is process-global.
 Observability (docs/observability.md) rides the shared orchestration:
 ``--obs_dir=...`` emits the schema-versioned metrics.jsonl/heartbeat
 with Mamba-family MFU/HFU (utils/flops.py dispatches on MambaConfig).
+So does async multi-tier checkpointing (docs/checkpointing.md):
+``--ckpt_local_dir=... --ckpt_local_interval=N`` adds the fast local
+tier beside the durable ``--ckpt_save_path``.
 
 Run:  python main_training_mamba.py --use_dummy_dataset=True --num_steps=100
 """

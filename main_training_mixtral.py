@@ -12,6 +12,9 @@ Observability (docs/observability.md) rides the shared orchestration:
 ``--obs_dir=...`` emits the schema-versioned metrics.jsonl/heartbeat;
 MoE MFU counts activated-expert FLOPs only (utils/flops.py) and the
 router's ``moe_drop_frac`` lands in each record's ``extra`` map.
+So does async multi-tier checkpointing (docs/checkpointing.md):
+``--ckpt_local_dir=... --ckpt_local_interval=N`` adds the fast local
+tier beside the durable ``--ckpt_save_path``.
 
 Run:  python main_training_mixtral.py --use_dummy_dataset=True \
           --expert_parallel_size=8 --num_steps=100

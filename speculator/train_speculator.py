@@ -178,10 +178,12 @@ def main(**kwargs):
         "step": jnp.zeros((), jnp.int32),
     }
 
-    checkpointer = Checkpointer(
-        cfg.ckpt_save_path, 1000, "ddp", rank,
-        verify=getattr(cfg, "checkpoint_verify", True),
-    )
+    # async multi-tier manager (ckpt/): same blocking-snapshot /
+    # background-commit contract as the pretraining entries; the
+    # speculator state is replicated, so parallel_mode is ddp
+    from fms_fsdp_tpu.ckpt import build_checkpoint_manager
+
+    checkpointer = build_checkpoint_manager(cfg, rank, parallel_mode="ddp")
     ckpt_loader = train_loader if hasattr(train_loader, "save_to_path") else None
     spec_state, _, start_step, tokens_seen, _ = checkpointer.load(
         spec_state,

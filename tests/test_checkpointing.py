@@ -225,6 +225,67 @@ def test_cleanup_ignores_non_step_entries(tmp_path):
     ]
 
 
+def test_cleanup_gcs_uncommitted_dirs_after_quiesce(tmp_path):
+    """A save torn before the metadata.json commit marker (state payload
+    or manifest but no marker, or an empty step dir) is invisible to the
+    retention quota and to every resume scanner — without GC it would
+    accumulate forever. _cleanup reclaims it after the quiesce window,
+    leaving committed checkpoints and loader auto-saves alone."""
+    ck = Checkpointer(str(tmp_path), 2, "fsdp", rank=0)
+    ck.PRUNE_QUIESCE_S = 0.0
+    root = tmp_path / "checkpoints"
+    root.mkdir(parents=True, exist_ok=True)
+    committed = root / "step_10_ckp"
+    os.makedirs(committed / "state")
+    (committed / "state" / "arr").write_text("x" * 64)
+    (committed / "metadata.json").write_text("{}")
+    # torn: orbax payload written, marker never landed (mid-write kill)
+    torn_state = root / "step_20_ckp"
+    os.makedirs(torn_state / "state")
+    (torn_state / "state" / "arr").write_text("x" * 64)
+    # torn: manifest landed, marker didn't (killed inside the commit)
+    torn_manifest = root / "step_30_ckp"
+    os.makedirs(torn_manifest)
+    (torn_manifest / "manifest.json").write_text("{}")
+    # torn: bare mkdir (killed before any write)
+    os.makedirs(root / "step_40_ckp")
+    # loader auto-save: not torn, governed by its own newest-two rule
+    loader_dir = root / "step_5_ckp"
+    os.makedirs(loader_dir)
+    (loader_dir / "loader_state_0.pkl").write_text("x")
+
+    ck._cleanup()  # pass 1 arms the torn candidates
+    assert {"step_20_ckp", "step_30_ckp", "step_40_ckp"} <= set(
+        os.listdir(root)
+    )
+    ck._cleanup()  # quiesce window elapsed, mtimes still: pruned
+    left = sorted(os.listdir(root))
+    assert left == ["step_10_ckp", "step_5_ckp"], left
+
+
+def test_cleanup_spares_active_async_write(tmp_path):
+    """A dir that looks torn because its async save is still flushing
+    (files deep inside the state payload keep changing) must not be
+    reclaimed under the writer: progress is detected by mtime change
+    across the whole tree, and only a still dir gets pruned."""
+    ck = Checkpointer(str(tmp_path), 2, "fsdp", rank=0)
+    ck.PRUNE_QUIESCE_S = 0.0
+    root = tmp_path / "checkpoints"
+    inflight = root / "step_20_ckp"
+    os.makedirs(inflight / "state")
+    shard = inflight / "state" / "shard0"
+    shard.write_text("x")
+    ck._cleanup()  # arms
+    # the writer makes progress deep in the tree (value arbitrary —
+    # only CHANGE matters, never comparison against the local clock)
+    old = time.time() - 7200
+    os.utime(shard, (old, old))
+    ck._cleanup()
+    assert inflight.is_dir()  # spared: mtime moved
+    ck._cleanup()  # now still across a full window: reclaimed
+    assert not inflight.exists()
+
+
 def test_cleanup_spares_inflight_loader_saves(tmp_path):
     """A loader auto-save dir still being written must not be rmtree'd
     under the writer, even when it falls outside the newest-two

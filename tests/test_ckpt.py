@@ -1,0 +1,412 @@
+"""Async multi-tier checkpoint manager (fms_fsdp_tpu/ckpt/).
+
+Covers the subsystem contract: blocking time bounded by the snapshot
+alone (background write off the critical path), at-most-one save in
+flight with backpressure, writer errors surfacing in the next
+save/finalize, sync-vs-async resume equivalence (bit-identical state),
+tier cadence + per-tier retention, cross-tier newest-committed-first
+resume (including after a mid-write kill), and the persisted shard
+quarantine set surviving the round trip.
+"""
+
+import os
+import pickle
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fms_fsdp_tpu.ckpt import (
+    AsyncCheckpointManager,
+    CheckpointTier,
+    build_checkpoint_manager,
+)
+from fms_fsdp_tpu.config import TrainConfig
+from fms_fsdp_tpu.resilience.faults import configure_faults
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    configure_faults("")
+    yield
+    configure_faults("")
+
+
+def _state(fill=0.0):
+    return {
+        "params": {"w": jnp.arange(16, dtype=jnp.float32) + fill},
+        "opt_state": {"mu": jnp.full((16,), fill, jnp.float32)},
+        "step": jnp.asarray(int(fill), jnp.int32),
+    }
+
+
+def _fresh():
+    return _state(0.0)
+
+
+def _mgr(tmp_path, local_interval=0, async_save=True, durable_interval=4):
+    cfg = TrainConfig(
+        ckpt_save_path=str(tmp_path / "durable"),
+        checkpoint_interval=durable_interval,
+        ckpt_local_dir=str(tmp_path / "local") if local_interval else "",
+        ckpt_local_interval=local_interval,
+        ckpt_local_keep=2,
+        ckpt_async=async_save,
+    )
+    return build_checkpoint_manager(cfg, rank=0)
+
+
+class _FakeLoader:
+    """Minimal stateful loader with the save_to_path/load_from_path
+    contract (per-rank pickle, like StatefulDataset)."""
+
+    def __init__(self, pos=0, rank=0):
+        self.pos = pos
+        self.rank = rank
+
+    def save_to_path(self, path):
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, f"loader_state_{self.rank}.pkl"), "wb") as f:
+            pickle.dump({"pos": self.pos}, f)
+
+    def load_from_path(self, path):
+        files = [x for x in os.listdir(path) if "loader" in x]
+        with open(os.path.join(path, sorted(files)[0]), "rb") as f:
+            self.pos = pickle.load(f)["pos"]
+
+
+class _SlowCommitCkptr:
+    """Fake slow filesystem: the storage write (flush) takes ``delay``
+    seconds; the snapshot (``save``, which returns once device arrays
+    are copied to host) stays fast. Wraps the tier's Orbax checkpointer."""
+
+    def __init__(self, inner, delay):
+        self._inner = inner
+        self.delay = delay
+
+    def save(self, *a, **kw):
+        return self._inner.save(*a, **kw)
+
+    def wait_until_finished(self):
+        time.sleep(self.delay)
+        return self._inner.wait_until_finished()
+
+
+# ---- async contract --------------------------------------------------------
+
+
+def test_async_blocking_bounded_by_snapshot(tmp_path):
+    """On a fake-slow filesystem, the step-boundary blocking time of an
+    async save is bounded by the snapshot alone — the storage write
+    latency lands on the background writer, not the loop."""
+    m = _mgr(tmp_path)
+    m.durable.ckp._ckptr = _SlowCommitCkptr(m.durable.ckp._ckptr, delay=2.0)
+    state = _state(3.0)
+    t0 = time.monotonic()
+    m.save(4, state, _FakeLoader(pos=7), tokens_seen=40)
+    blocked = time.monotonic() - t0
+    assert blocked < 1.0, f"save() blocked {blocked:.2f}s on storage latency"
+    # not yet committed: the writer is still flushing
+    step_dir = tmp_path / "durable" / "checkpoints" / "step_4_ckp"
+    stats = m.obs_stats()
+    assert stats["in_flight"] == 1
+    m.finalize()  # joins the writer; the commit marker lands
+    assert (step_dir / "metadata.json").is_file()
+    assert (step_dir / "manifest.json").is_file()
+    assert m.obs_stats()["in_flight"] == 0
+
+
+def test_backpressure_at_most_one_save_in_flight(tmp_path):
+    """A second save joins the in-flight writer before snapshotting:
+    the loop throttles instead of queueing unbounded snapshots."""
+    m = _mgr(tmp_path, durable_interval=2)
+    m.durable.ckp._ckptr = _SlowCommitCkptr(m.durable.ckp._ckptr, delay=1.0)
+    m.save(2, _state(1.0), None)
+    t0 = time.monotonic()
+    m.save(4, _state(2.0), None)  # must wait out save #1's writer
+    waited = time.monotonic() - t0
+    assert waited >= 0.9, f"second save did not backpressure ({waited:.2f}s)"
+    m.finalize()
+    ckps = sorted(os.listdir(tmp_path / "durable" / "checkpoints"))
+    assert ckps == ["step_2_ckp", "step_4_ckp"]
+
+
+def test_snapshot_isolates_later_mutation(tmp_path):
+    """The committed checkpoint holds the state as of the save call,
+    even though the loop rebinds/updates state while the background
+    write is still in flight."""
+    m = _mgr(tmp_path)
+    m.durable.ckp._ckptr = _SlowCommitCkptr(m.durable.ckp._ckptr, delay=0.5)
+    state = _state(5.0)
+    m.save(4, state, None)
+    # "train" while the write is in flight
+    _ = [_state(9.0) for _ in range(3)]
+    m.finalize()
+    m2 = _mgr(tmp_path)
+    loaded, _, step, _, _ = m2.load(_fresh(), None)
+    assert step == 4
+    np.testing.assert_array_equal(
+        np.asarray(loaded["params"]["w"]),
+        np.arange(16, dtype=np.float32) + 5.0,
+    )
+
+
+def test_writer_error_propagates_to_next_save_and_finalize(tmp_path):
+    """A writer-thread crash surfaces in the NEXT save (and finalize);
+    the affected dir stays uncommitted and resume falls back."""
+    m = _mgr(tmp_path, durable_interval=2)
+    m.save(2, _state(1.0), None, tokens_seen=20)
+    m.finalize()
+    configure_faults("ckpt_writer_crash:step=4")
+    m.save(4, _state(2.0), None, tokens_seen=40)
+    with pytest.raises(RuntimeError, match="background checkpoint writer"):
+        m.save(6, _state(3.0), None)
+    # the error is drained once; finalize after a clean save is quiet
+    configure_faults("ckpt_writer_crash:step=8")
+    m.save(8, _state(4.0), None)
+    with pytest.raises(RuntimeError, match="background checkpoint writer"):
+        m.finalize()
+    # torn dirs are invisible to resume: newest committed is step 2
+    m2 = _mgr(tmp_path, durable_interval=2)
+    loaded, _, step, ntok, resuming = m2.load(_fresh(), None)
+    assert resuming and step == 2 and ntok == 20
+    np.testing.assert_array_equal(
+        np.asarray(loaded["params"]["w"]),
+        np.arange(16, dtype=np.float32) + 1.0,
+    )
+
+
+def test_sync_async_resume_equivalence(tmp_path):
+    """Sync and async saves of the same state restore bit-identically:
+    params, optimizer state, and loader state."""
+    state = _state(11.0)
+    ms = _mgr(tmp_path / "sync", async_save=False)
+    ma = _mgr(tmp_path / "async", async_save=True)
+    ms.save(4, state, _FakeLoader(pos=13), tokens_seen=44)
+    ma.save(4, state, _FakeLoader(pos=13), tokens_seen=44)
+    ms.finalize()
+    ma.finalize()
+
+    outs = []
+    for root in (tmp_path / "sync", tmp_path / "async"):
+        m = _mgr(root)
+        loader = _FakeLoader()
+        loaded, loader, step, ntok, resuming = m.load(_fresh(), loader)
+        assert resuming and step == 4 and ntok == 44
+        outs.append((loaded, loader.pos))
+    (a, pos_a), (b, pos_b) = outs
+    assert pos_a == pos_b == 13
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ---- tiers -----------------------------------------------------------------
+
+
+def test_tier_cadence_and_retention(tmp_path):
+    """Local tier saves on its own cadence with tight retention; a
+    durable-step save satisfies the local cadence (no same-step double
+    write); per-tier GC prunes by each tier's own quota."""
+    m = _mgr(tmp_path, local_interval=2, durable_interval=4)
+    assert m.save_due(2) and m.save_due(4) and not m.save_due(3)
+    for step in (2, 4, 6, 8, 10):
+        m.save(step, _state(float(step)), None, tokens_seen=step)
+    m.finalize()
+    local = sorted(os.listdir(tmp_path / "local" / "checkpoints"))
+    durable = sorted(os.listdir(tmp_path / "durable" / "checkpoints"))
+    # local cadence steps 2,6,10 (4 and 8 went durable); keep=2 prunes 2
+    assert local == ["step_10_ckp", "step_6_ckp"], local
+    assert durable == ["step_4_ckp", "step_8_ckp"], durable
+
+
+def test_resume_newest_committed_across_tiers(tmp_path):
+    """Resume picks the newest COMMITTED step across all tiers — here
+    the local tier's, which is newer than the durable tier's."""
+    m = _mgr(tmp_path, local_interval=2, durable_interval=4)
+    m.save(4, _state(4.0), None, tokens_seen=4)
+    m.save(6, _state(6.0), None, tokens_seen=6)  # local tier
+    m.finalize()
+    m2 = _mgr(tmp_path, local_interval=2, durable_interval=4)
+    loaded, _, step, ntok, resuming = m2.load(_fresh(), None)
+    assert resuming and step == 6 and ntok == 6
+    np.testing.assert_array_equal(
+        np.asarray(loaded["params"]["w"]),
+        np.arange(16, dtype=np.float32) + 6.0,
+    )
+
+
+def test_mid_write_kill_falls_back_across_tiers(tmp_path):
+    """A save killed between snapshot and commit (torn dir, no marker)
+    is skipped; resume restores the newest committed checkpoint on
+    EITHER tier — durable step 4 here, with local step 2 also present
+    and local step 6 torn."""
+    m = _mgr(tmp_path, local_interval=2, durable_interval=4)
+    m.save(2, _state(2.0), None, tokens_seen=2)  # local, committed
+    m.save(4, _state(4.0), None, tokens_seen=4)  # durable, committed
+    m.finalize()
+    configure_faults("ckpt_writer_crash:tier=local:step=6")
+    m.save(6, _state(6.0), None, tokens_seen=6)  # local, TORN
+    with pytest.raises(RuntimeError, match="background checkpoint writer"):
+        m.finalize()
+    assert not (
+        tmp_path / "local" / "checkpoints" / "step_6_ckp" / "metadata.json"
+    ).exists()
+    m2 = _mgr(tmp_path, local_interval=2, durable_interval=4)
+    loaded, _, step, ntok, resuming = m2.load(_fresh(), None)
+    assert resuming and step == 4 and ntok == 4
+    np.testing.assert_array_equal(
+        np.asarray(loaded["params"]["w"]),
+        np.arange(16, dtype=np.float32) + 4.0,
+    )
+
+
+def test_forced_reasons_route_to_durable(tmp_path):
+    """final/preempt/abort/demand saves land on the durable tier even
+    off its cadence (the machine holding the local tier is the one
+    about to disappear)."""
+    m = _mgr(tmp_path, local_interval=2, durable_interval=100)
+    m.save(3, _state(3.0), None, reason="preempt", tokens_seen=3)
+    m.finalize()
+    durable = sorted(os.listdir(tmp_path / "durable" / "checkpoints"))
+    assert durable == ["step_3_ckp"], durable
+    assert not (tmp_path / "local" / "checkpoints" / "step_3_ckp").exists()
+
+
+# ---- quarantine set round trip --------------------------------------------
+
+
+def _write_arrow_shard(path, docs, start=0, doclen=8):
+    import pyarrow as pa
+
+    schema = pa.schema([pa.field("tokens", pa.uint32())])
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with pa.ipc.new_file(str(path), schema) as w:
+        for i in range(docs):
+            base = (start + i) * doclen
+            w.write(pa.record_batch([list(range(base, base + doclen))], schema))
+
+
+def _streaming_ds(datapath, retries=0):
+    from fms_fsdp_tpu.data.handlers import ArrowHandler
+    from fms_fsdp_tpu.data.streaming import StreamingDocDataset
+    from fms_fsdp_tpu.resilience.retry import RetryingShardHandler
+
+    return StreamingDocDataset(
+        str(datapath),
+        0,
+        1,
+        RetryingShardHandler(ArrowHandler(), retries=retries, backoff_s=0.01),
+        delimiter_token=-1,
+        max_chunksize=1000,
+    )
+
+
+def test_quarantine_set_survives_resume_and_walk_is_stable(tmp_path):
+    """The ROADMAP gap: a shard quarantined at setup (length probe
+    failed) contributes zero docs; a resume on a HEALED shard must
+    re-apply the persisted quarantine before the docset rebuild, so the
+    restored docset_index/lcg_state continue the exact same document
+    walk instead of replaying/skipping."""
+    ds = tmp_path / "ds"
+    _write_arrow_shard(ds / "shard_a.arrow", 5, 0)
+    _write_arrow_shard(ds / "shard_b.arrow", 5, 100)
+
+    # ground truth: uninterrupted stream with shard_b dead at setup
+    configure_faults("shard_read:path=shard_b")
+    gt = _streaming_ds(ds)
+    it = iter(gt)
+    stream = [np.asarray(next(it)) for _ in range(12)]
+    assert gt.setup_quarantined == ["shard_b.arrow"]
+
+    # fresh pipeline under the same fault: consume 5 chunks, checkpoint
+    configure_faults("shard_read:path=shard_b")
+    d1 = _streaming_ds(ds)
+    it1 = iter(d1)
+    for a, b in zip([next(it1) for _ in range(5)], stream):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    sd = d1.state_dict()
+    assert sd["StreamingDocDataset.setup_quarantined"] == ["shard_b.arrow"]
+    assert sd["StreamingDocDataset.quarantined_shards"] == ["shard_b.arrow"]
+
+    # healed resume: no fault now, but the persisted set must keep
+    # shard_b at zero docs so the walk continues exactly
+    configure_faults("")
+    d2 = _streaming_ds(ds)
+    d2.load_state_dict([sd], sharded_input=True)
+    assert d2.setup_quarantined == ["shard_b.arrow"]
+    assert d2._len == gt._len
+    it2 = iter(d2)
+    for a, b in zip([next(it2) for _ in range(7)], stream[5:]):
+        np.testing.assert_array_equal(np.asarray(a), b)
+
+    # control: WITHOUT the persisted set a healed setup doubles the
+    # docset — the restored position would walk shifted data
+    d3 = _streaming_ds(ds)
+    d3.setup()
+    assert d3._len != gt._len
+
+
+def test_own_setup_quarantine_survives_checkpoint_without_it(tmp_path):
+    """Loading a checkpoint that predates this run's own setup-probe
+    failure must not drop that shard from the persisted sets: the live
+    docset zeroes it, so a later save missing it would re-create the
+    shifted-walk bug one resume down the line."""
+    ds = tmp_path / "ds"
+    _write_arrow_shard(ds / "shard_a.arrow", 5, 0)
+    _write_arrow_shard(ds / "shard_b.arrow", 5, 100)
+
+    # checkpoint from a healthy run (no quarantine persisted)
+    healthy = _streaming_ds(ds)
+    it = iter(healthy)
+    for _ in range(3):
+        next(it)
+    sd = healthy.state_dict()
+    assert sd["StreamingDocDataset.setup_quarantined"] == []
+
+    # this run's setup finds shard_b dead — then loads the older state
+    configure_faults("shard_read:path=shard_b")
+    d = _streaming_ds(ds)
+    d.setup()
+    configure_faults("")
+    assert d.setup_quarantined == ["shard_b.arrow"]
+    d.load_state_dict([sd], sharded_input=True)
+    assert d.setup_quarantined == ["shard_b.arrow"]
+    assert "shard_b.arrow" in d.quarantined_shards
+    assert d.state_dict()["StreamingDocDataset.setup_quarantined"] == [
+        "shard_b.arrow"
+    ]
+
+
+def test_quarantine_set_rides_through_manager_kill_and_fallback(tmp_path):
+    """Acceptance: after a mid-write kill, resume restores the newest
+    committed checkpoint INCLUDING the loader's quarantine set."""
+    ds = tmp_path / "ds"
+    _write_arrow_shard(ds / "shard_a.arrow", 5, 0)
+    _write_arrow_shard(ds / "shard_b.arrow", 5, 100)
+    configure_faults("shard_read:path=shard_b")
+    loader = _streaming_ds(ds)
+    it = iter(loader)
+    for _ in range(4):
+        next(it)
+    assert loader.quarantined_shards == ["shard_b.arrow"]
+
+    m = _mgr(tmp_path, durable_interval=2)
+    configure_faults("")
+    m.save(2, _state(2.0), loader, tokens_seen=2)
+    m.finalize()
+    # newer save torn mid-write
+    configure_faults("ckpt_writer_crash:step=4")
+    m.save(4, _state(4.0), loader, tokens_seen=4)
+    with pytest.raises(RuntimeError, match="background checkpoint writer"):
+        m.finalize()
+
+    configure_faults("")
+    m2 = _mgr(tmp_path, durable_interval=2)
+    fresh_loader = _streaming_ds(ds)
+    loaded, fresh_loader, step, _, resuming = m2.load(_fresh(), fresh_loader)
+    assert resuming and step == 2
+    assert fresh_loader.quarantined_shards == ["shard_b.arrow"]
+    assert fresh_loader.setup_quarantined == ["shard_b.arrow"]

@@ -199,6 +199,29 @@ def _observer_record(**kw):
     return obs.report(10, 4, **args)
 
 
+def test_checkpoint_stats_provider_feeds_record():
+    """schema v2: the async checkpoint manager's stats provider fills
+    checkpoint_bg_s / checkpoint_in_flight; without a provider both
+    default to zero (plain synchronous Checkpointer)."""
+    rec = _observer_record()
+    assert rec["checkpoint_bg_s"] == 0.0
+    assert rec["checkpoint_in_flight"] == 0
+
+    obs = Observer(clock=FakeClock(), strict_schema=True)
+    obs.attach_checkpoint_stats(lambda: {"bg_s": 3.5, "in_flight": 1})
+    rec = obs.report(
+        10,
+        4,
+        loss=2.5,
+        tokens_per_sec_per_chip=1000.0,
+        skipped_steps_total=0,
+        skipped_steps_window=0,
+    )
+    assert rec["checkpoint_bg_s"] == pytest.approx(3.5)
+    assert rec["checkpoint_in_flight"] == 1
+    assert validate_record(rec) == []
+
+
 # ---- observer --------------------------------------------------------------
 
 

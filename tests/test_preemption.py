@@ -9,6 +9,8 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ARGS = [
@@ -161,3 +163,52 @@ def test_sigterm_checkpoints_and_resumes(tmp_path):
     out2 = open(log2).read()
     assert rc2 == 0, out2[-3000:]
     assert f"start_step = {saved_step}" in out2, out2[-2000:]
+
+
+@pytest.mark.slow
+def test_kill_mid_async_save_resumes_from_previous_commit(tmp_path):
+    """The process dies BETWEEN snapshot and commit (the async writer's
+    ckpt_precommit_kill fault site): the step-8 dir is fully written but
+    carries no metadata.json marker, so a restart must skip it and
+    resume from the previous committed interval save (step 4)."""
+    ckpt = str(tmp_path / "ckpt")
+    log1 = str(tmp_path / "run1.log")
+    proc = _launch(
+        ckpt,
+        log1,
+        extra=[
+            "--num_steps=40",
+            "--checkpoint_interval=4",
+            "--faults=ckpt_precommit_kill:step=8",
+        ],
+    )
+    try:
+        rc = proc.wait(timeout=420)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = open(log1).read()
+    assert rc != 0, "process should die mid-commit\n" + out[-3000:]
+
+    ckdir = os.path.join(ckpt, "checkpoints")
+    entries = sorted(os.listdir(ckdir))
+    assert "step_4_ckp" in entries and "step_8_ckp" in entries, entries
+    assert "metadata.json" in os.listdir(os.path.join(ckdir, "step_4_ckp"))
+    # torn: snapshot landed, commit marker did not
+    assert "metadata.json" not in os.listdir(
+        os.path.join(ckdir, "step_8_ckp")
+    ), "step 8 should be uncommitted"
+
+    # restart (fault cleared): resumes from the newest COMMITTED step
+    log2 = str(tmp_path / "run2.log")
+    proc2 = _launch(
+        ckpt, log2, extra=["--num_steps=8", "--checkpoint_interval=4"]
+    )
+    try:
+        rc2 = proc2.wait(timeout=420)
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+    out2 = open(log2).read()
+    assert rc2 == 0, out2[-3000:]
+    assert "start_step = 4" in out2, out2[-2000:]
