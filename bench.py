@@ -33,8 +33,8 @@ import time
 
 BASELINE_MFU = 0.68  # reference Llama2-7B MFU on A100 (BASELINE.md)
 
-PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
-ROW_TIMEOUT_S = int(os.environ.get("BENCH_ROW_TIMEOUT_S", "900"))
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
+ROW_TIMEOUT_S = float(os.environ.get("BENCH_ROW_TIMEOUT_S", "900"))
 
 
 def run_config(
@@ -92,6 +92,10 @@ def run_config(
         fused_loss=fused_loss,
         loss_chunk_size=loss_chunk,
         flash_kernel_variant=flash_variant,
+        # BENCH_KERNEL_TUNING=off races the static defaults against the
+        # tuned table (the default "auto" resolves tiles from
+        # KERNEL_TUNING.json; each row reports what it ran)
+        kernel_tuning=os.environ.get("BENCH_KERNEL_TUNING", "auto"),
     )
     model_cfg = get_model_config(variant)
     if model_overrides:
@@ -156,12 +160,20 @@ def run_config(
         * train_flops_per_token(model_cfg, cfg.seq_length, ac_fraction=ac_actual)
         / peak
     )
+    # tuned-vs-default is a first-class bench output: each row states
+    # the tuning mode it was built under and every kernel tile the
+    # trace-time lookup resolved (how=exact/nearest means the table
+    # spoke; default/off means today's static values ran)
+    from fms_fsdp_tpu.tune.lookup import choices, tuning_mode
+
     return {
         "mfu": round(mfu, 4),
         "hfu": round(hfu, 4),
         "tokens_per_sec_per_chip": round(tps),
         "step_time_s": round(best, 4),
         "loss": round(float(metrics["loss"]), 4),
+        "kernel_tuning": tuning_mode(),
+        "tuning": choices(),
     }
 
 
@@ -332,7 +344,11 @@ def _child_row(idx):
 
 
 def _run_subprocess(argv, timeout_s):
-    """Run argv; return (rc, stdout_text) or (None, reason) on timeout."""
+    """Run argv; return (rc, stdout_text) or (None, reason) on timeout.
+    On timeout the child's partial stdout (when any was captured) is
+    appended to the reason — it attributes WHERE the hang happened
+    (e.g. the probe's IMPORT_OK marker splits import-hang from
+    device-init-hang)."""
     try:
         proc = subprocess.run(
             argv,
@@ -342,17 +358,28 @@ def _run_subprocess(argv, timeout_s):
             text=True,
         )
         return proc.returncode, proc.stdout
-    except subprocess.TimeoutExpired:
-        return None, f"timeout after {timeout_s}s"
+    except subprocess.TimeoutExpired as e:
+        partial = e.stdout or b""
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        marks = " ".join(partial.split())[-120:]
+        reason = f"timeout after {timeout_s}s"
+        if marks:
+            reason += f" (partial output: {marks})"
+        return None, reason
     except Exception as e:  # noqa: BLE001
         return None, f"{type(e).__name__}: {e}"
 
 
 def _child_probe():
-    """Probe the backend in this process (child mode): same platform
-    pinning as run_config, so probe and rows always agree."""
+    """Probe the backend in this process (child mode): import +
+    device_count ONLY — the cheapest check that proves the accelerator
+    answers — with phase markers so a parent-side timeout can say which
+    phase hung. Same platform pinning as run_config, so probe and rows
+    always agree."""
     import jax
 
+    print("IMPORT_OK", flush=True)
     if os.environ.get("BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
     print("NCHIPS:" + str(len(jax.devices())))
@@ -373,27 +400,41 @@ def _probe_backend():
     return 0, f"backend probe rc={rc}: {' | '.join(tail)}"[:400]
 
 
+def _degraded_result(chip, err):
+    """The contract JSON line for an UNMEASURED run. ``degraded: true``
+    plus a null ``vs_baseline`` keep a dead TPU tunnel from reading as a
+    real MFU collapse in the perf trajectory (BENCH_r05 regressed this
+    way: a 240s probe timeout produced rc=0 with vs_baseline 0.0)."""
+    return {
+        "metric": "Llama2-7B-shaped train MFU "
+        f"(int8 fwd+dgrad GEMMs, {chip} chip)",
+        "value": 0.0,
+        "unit": "MFU",
+        "vs_baseline": None,
+        "degraded": True,
+        "bf16_mfu": None,
+        "bf16_vs_baseline": None,
+        "error": err,
+        "rows": [],
+    }
+
+
+def _finish(result):
+    """Print the contract line; under BENCH_STRICT=1 (CI) a degraded
+    record also exits nonzero so an unmeasured run can never pass as a
+    clean data point."""
+    print(json.dumps(result))
+    if result.get("degraded") and os.environ.get("BENCH_STRICT"):
+        sys.exit(3)
+
+
 def main():
     chip = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     n_chips, probe_err = _probe_backend()
 
     if probe_err is not None:
-        # Backend unavailable: still emit the contract JSON line at rc=0.
-        print(
-            json.dumps(
-                {
-                    "metric": "Llama2-7B-shaped train MFU "
-                    f"(int8 fwd+dgrad GEMMs, {chip} chip)",
-                    "value": 0.0,
-                    "unit": "MFU",
-                    "vs_baseline": 0.0,
-                    "bf16_mfu": None,
-                    "bf16_vs_baseline": None,
-                    "error": probe_err,
-                    "rows": [],
-                }
-            )
-        )
+        # Backend unavailable: still emit the contract JSON line.
+        _finish(_degraded_result(chip, probe_err))
         return
 
     # BENCH_ROWS="0,1" restricts the sweep to a row subset (the smoke
@@ -411,22 +452,9 @@ def main():
         if 0 not in indices:
             raise ValueError("must include the headline row 0")
     except (ValueError, AssertionError) as e:
-        # uphold the contract: bad input still yields the JSON line at rc=0
-        print(
-            json.dumps(
-                {
-                    "metric": "Llama2-7B-shaped train MFU "
-                    f"(int8 fwd+dgrad GEMMs, {chip} chip)",
-                    "value": 0.0,
-                    "unit": "MFU",
-                    "vs_baseline": 0.0,
-                    "bf16_mfu": None,
-                    "bf16_vs_baseline": None,
-                    "error": f"bad BENCH_ROWS={sel!r}: {e}"[:300],
-                    "rows": [],
-                }
-            )
-        )
+        # uphold the contract: bad input still yields the JSON line
+        # (degraded — nothing was measured)
+        _finish(_degraded_result(chip, f"bad BENCH_ROWS={sel!r}: {e}"[:300]))
         return
     rows = []
     for idx in indices:
@@ -463,11 +491,17 @@ def main():
         if bf16_label is not None
         else None
     )
+    head_mfu = head.get("mfu")
     result = {
         "metric": f"Llama2-7B-shaped train MFU (int8 fwd+dgrad GEMMs, {n_chips}x {chip} chip)",
-        "value": head.get("mfu", 0.0),
+        # an unmeasured headline (row crash/timeout) is degraded: value
+        # stays numeric for old consumers but vs_baseline goes null —
+        # never 0.0 for a run that produced no measurement
+        "value": head_mfu if head_mfu is not None else 0.0,
         "unit": "MFU",
-        "vs_baseline": round(head.get("mfu", 0.0) / BASELINE_MFU, 4),
+        "vs_baseline": (
+            round(head_mfu / BASELINE_MFU, 4) if head_mfu is not None else None
+        ),
         "mfu_convention": (
             "PaLM-style MFU against the chip's bf16 peak, the convention "
             "behind the reference's published 0.68; the headline row runs "
@@ -486,12 +520,14 @@ def main():
         "loss": head.get("loss"),
         "rows": rows,
     }
+    if head_mfu is None:
+        result["degraded"] = True
     if "error" in head:
         result["error"] = head["error"]
     if os.environ.get("BENCH_SMOKE"):
         result["smoke"] = True
         result["metric"] = "SMOKE (plumbing check at tiny shapes) " + result["metric"]
-    print(json.dumps(result))
+    _finish(result)
 
 
 if __name__ == "__main__":

@@ -79,6 +79,16 @@ class TrainConfig:
     # stays bf16) — see ops/quant.py. TPU-native win with no reference
     # counterpart.
     quantized_matmuls: str = "none"
+    # Kernel autotuning (docs/performance.md "Autotuning"): "auto" reads
+    # tile/block/chunk choices for flash, SSD, and fused-CE from the
+    # committed per-chip tuning table (KERNEL_TUNING.json), falling back
+    # nearest-signature -> static defaults; "off" forces today's static
+    # defaults bit-identically; a path reads that table instead. Resolved
+    # once per step build (like flash_kernel_variant) — pure table +
+    # cost-model lookup, never an on-device sweep. Regenerate the table
+    # with scripts/autotune_kernels.py on the target chip.
+    kernel_tuning: str = "auto"
+    kernel_tuning_table: str = ""  # explicit table path; "" = committed default
 
     # training spec (ref:fms_fsdp/config/training.py:37-43)
     batch_size: int = 2

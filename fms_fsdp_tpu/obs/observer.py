@@ -42,8 +42,13 @@ class Observer:
         peak_flops: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
         strict_schema: bool = False,
+        kernel_tuning: Optional[str] = None,
     ):
         self.registry = MetricRegistry()
+        # the kernel-tuning mode this run's step was built under (v3
+        # schema field); resolved tiles arrive via the registry
+        # (tune.lookup.attach_registry) as kernel.tune.* extras
+        self.kernel_tuning = kernel_tuning
         self.timer = PhaseTimer(clock=clock)
         self.goodput = GoodputTracker()
         self.sinks = sinks or []
@@ -163,6 +168,7 @@ class Observer:
             "goodput_overall": goodput_all,
             "skipped_steps": int(skipped_steps_total),
             "skipped_steps_window": int(skipped_steps_window),
+            "kernel_tuning": self.kernel_tuning,
             "memory_reserved_bytes": (
                 None
                 if memory_reserved_bytes is None
@@ -261,7 +267,7 @@ def build_observer(
         )
         peak = peak_flops_per_chip(getattr(cfg, "obs_chip_hint", "") or "")
 
-    return Observer(
+    obs = Observer(
         sinks=sinks,
         heartbeat=heartbeat,
         flops_per_token=flops,
@@ -269,4 +275,13 @@ def build_observer(
         peak_flops=peak,
         clock=clock,
         strict_schema=bool(getattr(cfg, "obs_strict_schema", False)),
+        kernel_tuning=getattr(cfg, "kernel_tuning", None),
     )
+    # resolved kernel tiles (kernel.tune.* gauges) land in this
+    # observer's registry from the trace-time lookup — attach before the
+    # first step build so nothing is lost (already-recorded choices are
+    # replayed on attach regardless)
+    from fms_fsdp_tpu.tune.lookup import attach_registry
+
+    attach_registry(obs.registry)
+    return obs

@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -56,6 +56,15 @@ SCHEMA_FIELDS = {
     "goodput_overall": ("float", False),
     "skipped_steps": ("int", True),
     "skipped_steps_window": ("int", True),
+    # v3: the kernel-tuning mode the run's step was built under
+    # ("auto" | "off" | a table path). The per-kernel resolved tiles ride
+    # in ``extra`` as kernel.tune.* gauges (flash block_q/block_k/kvgrid,
+    # ssd chunk, ce chunk, exact/nearest/default/pinned/off counters, and
+    # the block-degradation counter) — a run's perf record states which
+    # tiles produced it (flash gauges reflect post-divisibility-halving
+    # values; "pinned" = the call site or a non-default config value
+    # named the tile explicitly while tuning was on).
+    "kernel_tuning": ("str", False),
     "memory_reserved_bytes": ("int", False),
     "memory_allocated_bytes": ("int", False),
     "extra": ("map", False),
@@ -69,6 +78,9 @@ SCHEMA_DIGESTS = {
     # v2: + checkpoint_bg_s / checkpoint_in_flight (async checkpoint
     # manager: blocking-snapshot vs background-write split)
     2: "6fe196571d7fdf02da2dc0060f5151ddbcee7fae5275ad45277c0bce95be49c8",
+    # v3: + kernel_tuning (autotuner mode; resolved tiles ride in extra
+    # as kernel.tune.* gauges)
+    3: "f040074f56e65a7aef0e33bb7281fd38b6f1941115ee5e862412962b5f5c2a84",
 }
 
 

@@ -120,14 +120,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k, causal):
 
 
 @scoped("flash_attention_fwd")
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               variant=None):
     """q: (B, Nq, Sq, H); k/v: (B, Nkv, Sk, H) -> (o, lse).
 
     Two implementations (identical math/contract): the kv-resident
     fori_loop kernel below, and the kv-streamed grid kernel
-    (_fwd_kernel_kvgrid). FLASH_KERNEL_VARIANT / set_kernel_variant
-    overrides the automatic choice — raced on chip by scripts/bench_kernels.py."""
-    if _use_kvgrid(k.shape[2]):
+    (_fwd_kernel_kvgrid). ``variant`` pins the family for this call
+    (the tuning-table choice, resolved in flash_attention); otherwise
+    FLASH_KERNEL_VARIANT / set_kernel_variant overrides the automatic
+    choice — raced on chip by scripts/bench_kernels.py."""
+    if _use_kvgrid(k.shape[2], variant):
         return _flash_fwd_kvgrid(
             q, k, v, scale, causal, block_q, block_k, interpret
         )
@@ -590,7 +593,7 @@ def _dkv_kernel(
 
 def flash_dq(
     q, k, v, dout, lse, delta, *, scale, causal, block_q, block_k, interpret,
-    out_dtype=None,
+    out_dtype=None, variant=None,
 ):
     """dq of one attention partial, (B, N, S, H) layout. ``lse``/``delta``
     are the (global) softmax stats of the queries, (B, N, S, 1) fp32 —
@@ -599,10 +602,11 @@ def flash_dq(
     ring steps, so per-step rounding doesn't compound.
 
     The kv-streamed implementation engages automatically past the
-    resident kernels' sequence cap (or via FLASH_KERNEL_VARIANT=kvgrid) —
-    one rule for the forward and this kernel so the whole VJP shares a
-    residency model."""
-    if _use_kvgrid(k.shape[2]):
+    resident kernels' sequence cap (or via ``variant`` — the per-call
+    pin the VJP threads through so forward and backward always pick the
+    same family — or FLASH_KERNEL_VARIANT=kvgrid) — one rule for the
+    forward and this kernel so the whole VJP shares a residency model."""
+    if _use_kvgrid(k.shape[2], variant):
         return _flash_dq_kvgrid(
             q, k, v, dout, lse, delta, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, interpret=interpret,
@@ -718,7 +722,8 @@ def flash_dkv(q, k, v, dout, lse, delta, *, scale, causal, block_q, block_k, int
 
 
 @scoped("flash_attention_bwd")
-def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout, dlse=None):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, variant,
+               residuals, dout, dlse=None):
     """Backward for o (and optionally the lse output).
 
     A differentiable lse output only shifts the per-row delta: the lse
@@ -736,7 +741,7 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout, dlse
         scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
     )
-    dq = flash_dq(q, k, v, dout, lse, delta, **kw)
+    dq = flash_dq(q, k, v, dout, lse, delta, variant=variant, **kw)
     dk, dv = flash_dkv(q, k, v, dout, lse, delta, **kw)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -746,50 +751,74 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, dout, dlse
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_bnsh(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention_bnsh(
+    q, k, v, scale, causal, block_q, block_k, interpret, variant
+):
+    o, _ = _flash_fwd(
+        q, k, v, scale, causal, block_q, block_k, interpret, variant
+    )
     return o
 
 
-def _flash_attention_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_attention_fwd(
+    q, k, v, scale, causal, block_q, block_k, interpret, variant
+):
+    o, lse = _flash_fwd(
+        q, k, v, scale, causal, block_q, block_k, interpret, variant
+    )
     return o, (q, k, v, o, lse)
 
 
 _flash_attention_bnsh.defvjp(
     _flash_attention_fwd,
-    lambda scale, causal, bq, bk, interp, res, g: _flash_bwd(
-        scale, causal, bq, bk, interp, res, g
+    lambda scale, causal, bq, bk, interp, var, res, g: _flash_bwd(
+        scale, causal, bq, bk, interp, var, res, g
     ),
 )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_lse_bnsh(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention_lse_bnsh(
+    q, k, v, scale, causal, block_q, block_k, interpret, variant
+):
     """(o, lse) with lse (B, N, S, 1) fp32 as a *differentiable* output —
     the ring-attention building block (partials merge through lse)."""
-    return _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return _flash_fwd(
+        q, k, v, scale, causal, block_q, block_k, interpret, variant
+    )
 
 
-def _flash_attention_lse_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_attention_lse_fwd(
+    q, k, v, scale, causal, block_q, block_k, interpret, variant
+):
+    o, lse = _flash_fwd(
+        q, k, v, scale, causal, block_q, block_k, interpret, variant
+    )
     return (o, lse), (q, k, v, o, lse)
 
 
 _flash_attention_lse_bnsh.defvjp(
     _flash_attention_lse_fwd,
-    lambda scale, causal, bq, bk, interp, res, g: _flash_bwd(
-        scale, causal, bq, bk, interp, res, g[0], dlse=g[1]
+    lambda scale, causal, bq, bk, interp, var, res, g: _flash_bwd(
+        scale, causal, bq, bk, interp, var, res, g[0], dlse=g[1]
     ),
 )
 
 
-def _pick_block(seq: int, target: int) -> int:
+def _pick_block(seq: int, target: int, kind: str = "") -> int:
     b = min(seq, target)
     while seq % b != 0:
         b //= 2
-    return max(b, 1)
+    b = max(b, 1)
+    if kind and 2 * b < min(seq, target):
+        # divisibility halving degraded the tile below half the request
+        # (e.g. seq 2944 @ 512 -> 128) — count it in the obs registry
+        # and warn once; a silent 4x tile shrink is an MFU cliff
+        from fms_fsdp_tpu.tune.lookup import note_block_degradation
+
+        note_block_degradation(kind, seq, target, b)
+    return b
 
 
 # The resident kernels stage the full per-head sequence in VMEM (k+v
@@ -831,7 +860,13 @@ def set_kernel_variant(variant):
     _VARIANT = None if variant == "auto" else variant
 
 
-def _use_kvgrid(seq_k: int) -> bool:
+def _use_kvgrid(seq_k: int, variant=None) -> bool:
+    # per-call pin (the tuning-table family, threaded through the VJP)
+    # first; then the process-wide forcing; then the sequence-length rule
+    if variant == "kvgrid":
+        return True
+    if variant == "resident":
+        return False
     if _VARIANT == "kvgrid":
         return True
     if _VARIANT == "resident":
@@ -864,30 +899,60 @@ def flash_attention(
     *,
     causal: bool = True,
     scale=None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q=None,
+    block_k=None,
     interpret: bool = False,
     return_lse: bool = False,
+    variant=None,
 ):
     """q: (B, S, Nq, H); k/v: (B, S, Nkv, H) -> (B, S, Nq, H).
+
+    ``block_q``/``block_k``/``variant`` default to the tuning-table
+    resolution (fms_fsdp_tpu/tune/lookup.py): exact signature match,
+    then nearest signature, then the static 512/512 defaults —
+    bit-identical to the pre-tuner behavior when ``kernel_tuning="off"``
+    or the table has no legal entry. Passing them explicitly pins the
+    values (tests, ring attention's bwd partials). The resolution is
+    pure host table/cost-model work at trace time — never a sweep.
 
     With ``return_lse``, also returns the per-query logsumexp
     (B, S, Nq, 1) fp32 as a differentiable output, enabling exact
     merging of attention partials over disjoint kv sets (ring attention).
     """
+    from fms_fsdp_tpu.tune.lookup import (
+        record_final_flash_blocks,
+        resolve_flash,
+    )
+
     scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
-    block_q = _pick_block(q.shape[1], block_q)
-    block_k = _pick_block(k.shape[1], block_k)
+    # a per-call variant arg pins the family; else the process-wide
+    # forcing (set_kernel_variant) pins it; else the table may pick it
+    bq, bk, fam, _ = resolve_flash(
+        q.shape,
+        k.shape,
+        str(q.dtype),
+        requested_q=block_q,
+        requested_k=block_k,
+        requested_variant=variant if variant is not None else _VARIANT,
+    )
+    block_q = _pick_block(q.shape[1], bq, kind="q")
+    block_k = _pick_block(k.shape[1], bk, kind="k")
+    # the record must state what actually runs: the post-halving tiles
+    # AND the post-dispatch family (fam=None means the seq-length rule
+    # decides, which resolve_flash could not know)
+    record_final_flash_blocks(
+        block_q, block_k, kvgrid=_use_kvgrid(k.shape[1], fam)
+    )
     # kernels run in (B, N, S, H)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     if return_lse:
         ot, lse = _flash_attention_lse_bnsh(
-            qt, kt, vt, scale, causal, block_q, block_k, interpret
+            qt, kt, vt, scale, causal, block_q, block_k, interpret, fam
         )
         return jnp.swapaxes(ot, 1, 2), jnp.swapaxes(lse, 1, 2)
     ot = _flash_attention_bnsh(
-        qt, kt, vt, scale, causal, block_q, block_k, interpret
+        qt, kt, vt, scale, causal, block_q, block_k, interpret, fam
     )
     return jnp.swapaxes(ot, 1, 2)

@@ -162,12 +162,19 @@ def ring_attention(q, k, v, mesh, *, causal: bool = True, scale=None):
 
     def partial_fn(q_loc, k_cur, v_cur, diag: bool):
         if use_flash:
+            # pin the same blocks the backward partials below use —
+            # passing them explicitly also skips the tuning-table
+            # lookup, so fwd and bwd ring steps always run the same
+            # tiles/family (the per-ring-step local shapes would
+            # otherwise nearest-match full-sequence table entries)
             return flash_attention(
                 q_loc,
                 k_cur,
                 v_cur,
                 causal=diag,
                 scale=scale,
+                block_q=bq,
+                block_k=bk,
                 interpret=interpret,
                 return_lse=True,
             )

@@ -311,7 +311,14 @@ def ssd_scan(
     wrapping — GSPMD partitions it fine."""
     Bsz, S, H, P = x.shape
     G, N = Bm.shape[2], Bm.shape[3]
-    L = min(chunk_size, S)
+    # chunk length: the tuning table may override the config's static
+    # value (kernel_tuning="auto"); with tuning off (or no legal entry)
+    # this is exactly min(chunk_size, S) — today's behavior
+    from fms_fsdp_tpu.tune.lookup import resolve_ssd_chunk
+
+    L = resolve_ssd_chunk(
+        x.shape, G, N, str(x.dtype), requested=min(chunk_size, S)
+    )
     assert S % L == 0, f"seq len {S} must be a multiple of chunk {L}"
     C = S // L
 

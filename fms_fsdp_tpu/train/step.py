@@ -214,6 +214,18 @@ def make_train_step(
     from fms_fsdp_tpu.ops.attention import configure_flash_variant
 
     configure_flash_variant(getattr(cfg, "flash_kernel_variant", None))
+    # kernel tuning mode/table resolved once per step build, same
+    # discipline as the flash variant: cached jits can never disagree
+    # with the config that built them
+    from fms_fsdp_tpu.tune.lookup import (
+        configure_kernel_tuning,
+        resolve_ce_chunk,
+    )
+
+    configure_kernel_tuning(
+        getattr(cfg, "kernel_tuning", None),
+        getattr(cfg, "kernel_tuning_table", "") or None,
+    )
     _, forward_fn, _, n_layers = get_model_api(model_cfg)
     ac_mask = None
     if cfg.fsdp_activation_checkpointing:
@@ -222,6 +234,21 @@ def make_train_step(
 
     fused = cfg.fused_loss
     chunk = cfg.loss_chunk_size
+    if fused:
+        # the logits-chunk knob is tunable: table override under
+        # kernel_tuning="auto", exactly cfg.loss_chunk_size when "off"
+        d_model = getattr(model_cfg, "emb_dim", None) or getattr(
+            model_cfg, "d_model", 0
+        )
+        vocab = getattr(model_cfg, "src_vocab_size", None) or getattr(
+            model_cfg, "vocab_size", 0
+        )
+        chunk = resolve_ce_chunk(
+            d_model,
+            vocab,
+            jnp.dtype(policy.compute_dtype).name,
+            requested=chunk,
+        )
 
     # resilience: skip-on-nonfinite guard + the nan_loss injection site
     # (both resolved at trace time — no per-step host involvement)

@@ -1,0 +1,339 @@
+"""Candidate tile configs per kernel + the static VMEM cost model.
+
+Everything here is pure host arithmetic over python ints — no jax, no
+device, no clock — so candidate generation and pruning run identically
+on a chipless CI host and inside the trace-time lookup path.
+
+The cost model reuses the residency math the kernels themselves
+document:
+
+- flash resident family (ops/flash_attention.py): the whole per-head kv
+  stream lives in VMEM (k+v forward, k+v for dq) — ~``2 * S * H *
+  dtype_bytes`` per operand, double-buffered by Mosaic because the
+  block index map changes across grid cells; q/o/do/stat blocks ride
+  alongside. This is the "~8 * S * H bytes" note above MAX_KERNEL_SEQ,
+  and the model reproduces that 8k bf16 cap exactly
+  (tests/test_tune.py::test_cost_model_matches_resident_cap).
+- flash kvgrid family: O(block) residency — q/k/v/o blocks plus the
+  fp32 (block_q, head) online-softmax scratch; independent of S.
+- dk/dv kernel (shared by both families): kv blocks resident across the
+  (group, q-block) sweep plus two fp32 (block_k, head) scratch
+  accumulators.
+- SSD fused kernel (ops/ssd.py): (L, L) fp32 C@B^T scratch, the
+  per-group-member (R, N, P) fp32 carried state, and the L-row operand
+  blocks.
+- fused CE (ops/fused_ce.py): an XLA scan, not a Pallas kernel — the
+  constraint is the fp32 (chunk, V) logits tile (one live in fwd, two in
+  bwd: p and d_logits), budgeted against HBM headroom rather than VMEM.
+"""
+
+from typing import Dict, List, Optional
+
+# Per-core VMEM budget by chip kind. ~16 MiB/core is the working figure
+# the shipped kernels were sized against (the resident flash family's 8k
+# bf16 sequence cap lands exactly at this budget); chips we have not
+# measured inherit the conservative default.
+CHIP_VMEM_BYTES: Dict[str, int] = {
+    "v4": 16 << 20,
+    "v5e": 16 << 20,
+    "v5p": 16 << 20,
+    "v6e": 16 << 20,
+    "cpu": 16 << 20,  # interpret mode runs the same block algebra
+}
+DEFAULT_VMEM_BYTES = 16 << 20
+
+# HBM headroom budget for the fused-CE logits tile (the tile competes
+# with params/activations for the 16 GB chip). 8 GiB is calibrated
+# against measured reality: the 128k-vocab long-context bench rows run
+# chunk=4096 (a ~4.2 GiB fp32 tile pair) on a 16 GB v5e, so the budget
+# must admit it; 8192 at 128k vocab (~8.4 GiB) is where a full train
+# step stops fitting.
+CE_HBM_BUDGET_BYTES = 8 << 30
+
+DTYPE_BYTES = {
+    "bfloat16": 2,
+    "float16": 2,
+    "float32": 4,
+    "int8": 1,
+}
+
+# Mosaic double-buffers grid-streamed blocks (the next cell's DMA runs
+# behind the current cell's compute).
+_DB = 2
+
+# Today's static defaults — the last link of the fallback chain, and the
+# values `kernel_tuning="off"` must reproduce bit-identically.
+FLASH_DEFAULT_BLOCK_Q = 512
+FLASH_DEFAULT_BLOCK_K = 512
+SSD_DEFAULT_CHUNK = 256
+CE_DEFAULT_CHUNK = 4096
+
+_BLOCK_CHOICES = (128, 256, 512, 1024, 2048)
+_SSD_CHUNK_CHOICES = (128, 256, 512)
+_CE_CHUNK_CHOICES = (1024, 2048, 4096, 8192, 16384)
+
+
+def dtype_bytes(dtype: str) -> int:
+    return DTYPE_BYTES.get(str(dtype), 4)
+
+
+def vmem_budget(chip: str) -> int:
+    return CHIP_VMEM_BYTES.get(chip, DEFAULT_VMEM_BYTES)
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+
+
+def flash_sig(q_shape, k_shape) -> Dict[str, int]:
+    """Shape signature of one attention call, (B, S, N, H) layout."""
+    b, sq, nq, h = q_shape
+    _, sk, nkv, _ = k_shape
+    return {
+        "batch": int(b),
+        "nq": int(nq),
+        "nkv": int(nkv),
+        "seq_q": int(sq),
+        "seq_k": int(sk),
+        "head": int(h),
+    }
+
+
+def _flash_fwd_resident_bytes(sig, db, bq):
+    h, sk = sig["head"], sig["seq_k"]
+    kv = 2 * sk * h * db * _DB  # k + v, whole per-head stream
+    q_o = 2 * bq * h * db * _DB  # q in + o out blocks
+    lse = bq * 4 * _DB
+    acc = bq * h * 4 + 2 * bq * 4  # fp32 acc + running max/denominator
+    return kv + q_o + lse + acc
+
+
+def _flash_fwd_kvgrid_bytes(sig, db, bq, bk):
+    h = sig["head"]
+    kv = 2 * bk * h * db * _DB
+    q_o = 2 * bq * h * db * _DB
+    lse = bq * 4 * _DB
+    scratch = bq * h * 4 + 2 * bq * 4  # VMEM scratch: acc, m, l
+    return kv + q_o + lse + scratch
+
+
+def _flash_dq_resident_bytes(sig, db, bq):
+    h, sk = sig["head"], sig["seq_k"]
+    kv = 2 * sk * h * db * _DB
+    blocks = 3 * bq * h * db * _DB  # q, do in + dq out
+    stats = 2 * bq * 4 * _DB  # lse, delta
+    acc = bq * h * 4  # fori-loop fp32 dq accumulator
+    return kv + blocks + stats + acc
+
+
+def _flash_dq_kvgrid_bytes(sig, db, bq, bk):
+    h = sig["head"]
+    kv = 2 * bk * h * db * _DB
+    blocks = 3 * bq * h * db * _DB
+    stats = 2 * bq * 4 * _DB
+    scratch = bq * h * 4
+    return kv + blocks + stats + scratch
+
+
+def _flash_dkv_bytes(sig, db, bq, bk):
+    # shared by both families: kv blocks resident across the (g, qi)
+    # sweep, q/do streamed, two fp32 scratch accumulators
+    h = sig["head"]
+    kv_blocks = 2 * bk * h * db * _DB
+    dkv_out = 2 * bk * h * 4 * _DB  # fp32 outputs
+    q_do = 2 * bq * h * db * _DB
+    stats = 2 * bq * 4 * _DB
+    scratch = 2 * bk * h * 4
+    return kv_blocks + dkv_out + q_do + stats + scratch
+
+
+def flash_vmem_bytes(family: str, sig: Dict[str, int], dtype: str,
+                     block_q: int, block_k: int) -> int:
+    """Worst-case per-core VMEM over the kernels a training step runs
+    (fwd + dq + dkv) for one family/tile choice."""
+    db = dtype_bytes(dtype)
+    if family == "resident":
+        fwd = _flash_fwd_resident_bytes(sig, db, block_q)
+        dq = _flash_dq_resident_bytes(sig, db, block_q)
+    else:
+        fwd = _flash_fwd_kvgrid_bytes(sig, db, block_q, block_k)
+        dq = _flash_dq_kvgrid_bytes(sig, db, block_q, block_k)
+    dkv = _flash_dkv_bytes(sig, db, block_q, block_k)
+    return max(fwd, dq, dkv)
+
+
+def _legal_block(seq: int, b: int) -> bool:
+    return b <= seq and seq % b == 0
+
+
+def flash_candidates(sig: Dict[str, int], dtype: str, chip: str) -> List[Dict]:
+    """Legal (family, block_q, block_k) configs under the VMEM budget,
+    smallest-footprint last so the sweep can time cheap ones first."""
+    budget = vmem_budget(chip)
+    out = []
+    for family in ("resident", "kvgrid"):
+        for bq in _BLOCK_CHOICES:
+            if not _legal_block(sig["seq_q"], bq):
+                continue
+            for bk in _BLOCK_CHOICES:
+                if not _legal_block(sig["seq_k"], bk):
+                    continue
+                vmem = flash_vmem_bytes(family, sig, dtype, bq, bk)
+                if vmem > budget:
+                    continue
+                out.append(
+                    {
+                        "family": family,
+                        "block_q": bq,
+                        "block_k": bk,
+                        "vmem_bytes": vmem,
+                    }
+                )
+    return out
+
+
+def flash_config_legal(config: Dict, sig: Dict[str, int], dtype: str,
+                       chip: str) -> bool:
+    """Is a table entry's config runnable for this exact shape on this
+    chip? (Nearest-signature fallbacks must re-check: a block that
+    divided the neighbor's sequence may not divide ours, and a resident
+    pick near the cap may not fit a longer sequence.)"""
+    family = config.get("family")
+    bq = config.get("block_q", FLASH_DEFAULT_BLOCK_Q)
+    bk = config.get("block_k", FLASH_DEFAULT_BLOCK_K)
+    if family not in (None, "resident", "kvgrid"):
+        return False
+    if not isinstance(bq, int) or not isinstance(bk, int):
+        return False
+    if not (_legal_block(sig["seq_q"], bq) and _legal_block(sig["seq_k"], bk)):
+        return False
+    fam = family or "resident"
+    return flash_vmem_bytes(fam, sig, dtype, bq, bk) <= vmem_budget(chip)
+
+
+def resident_max_seq(head: int, dtype: str, chip: str,
+                     block_q: int = FLASH_DEFAULT_BLOCK_Q) -> int:
+    """Largest power-of-two seq_k the resident family fits under the
+    chip's VMEM budget — the cost-model restatement of MAX_KERNEL_SEQ."""
+    s = 256
+    while True:
+        sig = {"batch": 1, "nq": 1, "nkv": 1, "seq_q": s * 2,
+               "seq_k": s * 2, "head": head}
+        if flash_vmem_bytes("resident", sig, dtype, block_q,
+                            FLASH_DEFAULT_BLOCK_K) > vmem_budget(chip):
+            return s
+        s *= 2
+
+
+# ---------------------------------------------------------------------------
+# SSD (Mamba2 chunked scan)
+# ---------------------------------------------------------------------------
+
+
+def ssd_sig(x_shape, groups: int, dstate: int) -> Dict[str, int]:
+    """x (B, S, H, P); groups/dstate from the B/C projections."""
+    b, s, h, p = x_shape
+    return {
+        "batch": int(b),
+        "seq": int(s),
+        "heads": int(h),
+        "headdim": int(p),
+        "groups": int(groups),
+        "dstate": int(dstate),
+    }
+
+
+def ssd_vmem_bytes(sig: Dict[str, int], dtype: str, chunk: int) -> int:
+    """Fused-kernel residency for chunk length L: the (L, L) fp32
+    C@B^T scratch, the (R, N, P) fp32 carried state, and the L-row
+    operand/output blocks (ops/ssd.py::_fused_kernel)."""
+    db = dtype_bytes(dtype)
+    L = chunk
+    p, n = sig["headdim"], sig["dstate"]
+    r = max(1, sig["heads"] // max(1, sig["groups"]))
+    cb = L * L * 4
+    state = r * n * p * 4
+    x_blk = L * p * db * _DB
+    bc_blk = 2 * L * n * db * _DB
+    rows = 2 * L * 4 * _DB  # cum + dt (1, L) fp32 rows
+    y_out = L * p * 4 * _DB  # fp32 output block
+    return cb + state + x_blk + bc_blk + rows + y_out
+
+
+def ssd_candidates(sig: Dict[str, int], dtype: str, chip: str) -> List[Dict]:
+    budget = vmem_budget(chip)
+    out = []
+    for L in _SSD_CHUNK_CHOICES:
+        if L > sig["seq"] or sig["seq"] % L != 0:
+            continue
+        vmem = ssd_vmem_bytes(sig, dtype, L)
+        if vmem > budget:
+            continue
+        out.append({"chunk": L, "vmem_bytes": vmem})
+    return out
+
+
+def ssd_config_legal(config: Dict, sig: Dict[str, int], dtype: str,
+                     chip: str) -> bool:
+    L = config.get("chunk")
+    if not isinstance(L, int) or L <= 0:
+        return False
+    if L > sig["seq"] or sig["seq"] % L != 0:
+        return False
+    return ssd_vmem_bytes(sig, dtype, L) <= vmem_budget(chip)
+
+
+# ---------------------------------------------------------------------------
+# fused CE (chunked lm-head + cross-entropy)
+# ---------------------------------------------------------------------------
+
+
+def ce_sig(d_model: int, vocab: int) -> Dict[str, int]:
+    return {"d_model": int(d_model), "vocab": int(vocab)}
+
+
+def ce_working_set_bytes(sig: Dict[str, int], dtype: str, chunk: int) -> int:
+    """Live-tile bytes of one bwd scan step: the fp32 (chunk, V) p and
+    d_logits tiles plus the (chunk, D) x tile (ops/fused_ce.py)."""
+    db = dtype_bytes(dtype)
+    return 2 * chunk * sig["vocab"] * 4 + chunk * sig["d_model"] * db
+
+
+def ce_candidates(sig: Dict[str, int], dtype: str, chip: str) -> List[Dict]:
+    del chip  # the CE tile is HBM-budgeted, not VMEM-budgeted
+    out = []
+    for c in _CE_CHUNK_CHOICES:
+        ws = ce_working_set_bytes(sig, dtype, c)
+        if ws > CE_HBM_BUDGET_BYTES:
+            continue
+        out.append({"chunk": c, "working_set_bytes": ws})
+    return out
+
+
+def ce_config_legal(config: Dict, sig: Dict[str, int], dtype: str,
+                    chip: str) -> bool:
+    del chip
+    c = config.get("chunk")
+    if not isinstance(c, int) or c <= 0:
+        return False
+    return ce_working_set_bytes(sig, dtype, c) <= CE_HBM_BUDGET_BYTES
+
+
+LEGALITY = {
+    "flash_attention": flash_config_legal,
+    "ssd": ssd_config_legal,
+    "fused_ce": ce_config_legal,
+}
+
+CANDIDATES = {
+    "flash_attention": flash_candidates,
+    "ssd": ssd_candidates,
+    "fused_ce": ce_candidates,
+}
+
+
+def config_legal(kernel: str, config: Dict, sig: Dict[str, int], dtype: str,
+                 chip: str) -> bool:
+    fn = LEGALITY.get(kernel)
+    return bool(fn and fn(config, sig, dtype, chip))

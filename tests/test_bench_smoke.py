@@ -24,6 +24,8 @@ def test_bench_smoke_schema():
         BENCH_ROWS="0,1",
         BENCH_PROBE_TIMEOUT_S="300",
         BENCH_ROW_TIMEOUT_S="300",
+        # strict mode must NOT trip on a clean (non-degraded) run
+        BENCH_STRICT="1",
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -58,4 +60,11 @@ def test_bench_smoke_schema():
         assert "error" not in row, row
         assert row["tokens_per_sec_per_chip"] > 0
         assert row["step_time_s"] > 0
+        # tuned-vs-default is a per-row first-class output: every row
+        # states its tuning mode and the kernel tiles it resolved
+        assert row["kernel_tuning"] in ("auto", "off"), row
+        assert isinstance(row["tuning"], dict), row
+
+    # a measured run is never degraded
+    assert not out.get("degraded"), out
     assert out["bf16_mfu"] is not None and out["bf16_vs_baseline"] is not None
