@@ -8,6 +8,11 @@ Checkpointer`` remains as the synchronous compatibility layer (and the
 per-tier backend).
 """
 
+from fms_fsdp_tpu.ckpt.elastic import (
+    check_rescale,
+    current_fingerprint,
+    topology_digest,
+)
 from fms_fsdp_tpu.ckpt.manager import (
     AsyncCheckpointManager,
     CheckpointTier,
@@ -18,4 +23,7 @@ __all__ = [
     "AsyncCheckpointManager",
     "CheckpointTier",
     "build_checkpoint_manager",
+    "check_rescale",
+    "current_fingerprint",
+    "topology_digest",
 ]

@@ -1,0 +1,233 @@
+"""Elastic resume: topology fingerprinting and rescale legality.
+
+Preemptible TPU capacity rarely comes back at the size that died: a
+32-host run restarts on 16, a 4-chip slice on 8. The data layer already
+reshards (``data/stateful.py`` fractional ownership over
+``ScalableShardDataset`` logical shards) and the Orbax restore already
+lands shards on whatever mesh the new world built — but nothing used to
+*record* the save-time topology or *validate* that a restart can legally
+consume it. This module owns both halves of that contract:
+
+- ``current_fingerprint(cfg)`` builds the topology dict every checkpoint
+  stamps into ``metadata.json`` under the ``"topology"`` key (both the
+  synchronous ``Checkpointer.save`` and every ``AsyncCheckpointManager``
+  tier);
+- ``check_rescale(old, new)`` decides, *before* any collective restore
+  is entered, whether the restart world can consume the checkpoint —
+  returning actionable problems instead of letting the run die later in
+  an opaque Orbax sharding error or a silently shifted document walk.
+
+The field set is a cross-run contract (old checkpoints are read by new
+code): changing it without bumping ``TOPOLOGY_VERSION`` fails CI via the
+pinned digest, exactly like the obs metric schema
+(``fms_fsdp_tpu/obs/schema.py``).
+
+Policy (docs/checkpointing.md "Elastic resume"): the *global* batch is
+preserved across a rescale — per-rank rows are recomputed from the
+checkpoint's ``global_batch_rows`` (``data/loader.py::
+elastic_batch_size``) so ``tokens_seen``, the LR schedule, and the loss
+trajectory stay meaningful. A rescale that cannot preserve it (rows do
+not divide the new data-parallel extent), or an explicit batch/seq
+change, is a hard error unless ``--allow_batch_change=True``.
+"""
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+TOPOLOGY_VERSION = 1
+
+# name -> type tag. The topology fingerprint stamped into every
+# checkpoint's metadata.json (key "topology"). ``loader_files`` is the
+# number of per-rank loader_state files the save wrote (0 when no
+# dataloader rode along) == process_count * num_workers of the saving
+# run; it is the world size the loader state reshards FROM.
+TOPOLOGY_FIELDS = {
+    "process_count": "int",
+    "device_count": "int",
+    "tensor_parallel_size": "int",
+    "context_parallel_size": "int",
+    "global_batch_rows": "int",
+    "seq_length": "int",
+    "n_logical_shards": "int",
+    "loader_files": "int",
+}
+
+# Digest of the canonical field serialization per published version; a
+# mismatch for the CURRENT version means the fingerprint contract
+# changed without a version bump (pinned in CI, tests/test_elastic.py).
+TOPOLOGY_DIGESTS = {
+    1: "a8d823b4a35b82fa1e2c91d376e485caf15a6f4558edfe0696426dd7ea129334",
+}
+
+
+def topology_digest() -> str:
+    canon = json.dumps(
+        {"version": TOPOLOGY_VERSION, "fields": TOPOLOGY_FIELDS},
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+def data_parallel_rows_extent(cfg, device_count: int) -> int:
+    """Data-parallel extent (replica x fsdp x expert) the global batch
+    spreads over — the mesh-free mirror of ``parallel.mesh.
+    data_parallel_extent`` (every mesh axis not tensor/context carries
+    batch rows)."""
+    tp = max(1, int(getattr(cfg, "tensor_parallel_size", 1) or 1))
+    cp = max(1, int(getattr(cfg, "context_parallel_size", 1) or 1))
+    return max(1, device_count // tp // cp)
+
+
+def current_fingerprint(
+    cfg, process_count: Optional[int] = None, device_count: Optional[int] = None
+) -> Dict[str, int]:
+    """The live world's topology fingerprint, from TrainConfig + the
+    initialized JAX world. ``loader_files`` is the EXPECTED per-rank
+    loader state count (process_count x num_workers; 0 when the run has
+    no stateful loader) — the save path substitutes 0 when no dataloader
+    actually rides along."""
+    import jax
+
+    pc = jax.process_count() if process_count is None else int(process_count)
+    dc = jax.device_count() if device_count is None else int(device_count)
+    data_extent = data_parallel_rows_extent(cfg, dc)
+    stateful_loader = not bool(getattr(cfg, "use_dummy_dataset", False))
+    workers = max(1, int(getattr(cfg, "num_workers", 1) or 1))
+    return {
+        "process_count": pc,
+        "device_count": dc,
+        "tensor_parallel_size": max(
+            1, int(getattr(cfg, "tensor_parallel_size", 1) or 1)
+        ),
+        "context_parallel_size": max(
+            1, int(getattr(cfg, "context_parallel_size", 1) or 1)
+        ),
+        "global_batch_rows": int(cfg.batch_size) * data_extent,
+        "seq_length": int(cfg.seq_length),
+        "n_logical_shards": int(getattr(cfg, "logical_shards", 0) or 0),
+        "loader_files": pc * workers if stateful_loader else 0,
+    }
+
+
+def describe_change(old: Dict, new: Dict) -> str:
+    """Compact "field: old -> new" summary of the differing fields."""
+    parts = [
+        f"{k}: {old.get(k)} -> {new.get(k)}"
+        for k in TOPOLOGY_FIELDS
+        if old.get(k) != new.get(k)
+    ]
+    return ", ".join(parts)
+
+
+def stamp_topology(metadata: Dict, fingerprint: Optional[Dict], dataloader) -> Dict:
+    """Stamp ``metadata["topology"]`` for a save (no-op without a
+    fingerprint). Shared by the synchronous ``Checkpointer.save`` and
+    every ``AsyncCheckpointManager`` tier so the stamped contract cannot
+    fork between the two save paths: ``loader_files`` records what THIS
+    save wrote (the expected count, not a listdir — peers' files may not
+    be visible yet on shared storage), 0 when no dataloader rode along."""
+    if fingerprint is not None:
+        metadata["topology"] = dict(
+            fingerprint,
+            loader_files=(
+                fingerprint.get("loader_files", 0)
+                if dataloader is not None
+                else 0
+            ),
+        )
+    return metadata
+
+
+def _count_loader_files(ckp_dir: str) -> int:
+    try:
+        return len(
+            [f for f in os.listdir(ckp_dir) if f.startswith("loader_state")]
+        )
+    except OSError:
+        return 0
+
+
+def check_rescale(
+    old: Dict,
+    new: Dict,
+    ckp_dir: Optional[str] = None,
+    allow_batch_change: bool = False,
+) -> Tuple[List[str], bool]:
+    """Validate that the ``new`` world may consume a checkpoint stamped
+    with ``old``. Returns ``(problems, changed)`` — ``problems`` is a
+    list of actionable error strings (empty = legal), ``changed`` is
+    True when any topology field differs (a legal elastic resume).
+
+    Every check runs BEFORE the collective Orbax restore, so an illegal
+    rescale fails fast on every host with the same message instead of
+    deadlocking half the pod inside a collective. The caller is
+    responsible for making the verdict collective (``_all_agree``) —
+    the on-disk loader-file count below is a local observation that
+    eventually-consistent shared storage could briefly split."""
+    changed = any(old.get(k) != new.get(k) for k in TOPOLOGY_FIELDS)
+    if not changed:
+        return [], False
+    problems: List[str] = []
+
+    old_logical = int(old.get("n_logical_shards") or 0)
+    new_logical = int(new.get("n_logical_shards") or 0)
+    if old_logical != new_logical:
+        problems.append(
+            f"n_logical_shards changed ({old_logical} -> {new_logical}): "
+            f"the logical-shard count is fixed when the run first saves; "
+            f"restart with --logical_shards={old_logical}"
+        )
+
+    old_lw = int(old.get("loader_files") or 0)
+    new_lw = int(new.get("loader_files") or 0)
+    if old_lw and new_lw and old_logical and old_logical % new_lw != 0:
+        legal = [
+            d
+            for d in range(1, old_logical + 1)
+            if old_logical % d == 0
+        ]
+        problems.append(
+            f"new loader world {new_lw} (process_count x num_workers) does "
+            f"not divide n_logical_shards {old_logical}; loader state "
+            f"cannot be repartitioned. Legal process x worker products: "
+            f"{legal} — adjust --num_workers (or the host count) to one "
+            f"of them"
+        )
+
+    if old_lw and ckp_dir is not None:
+        found = _count_loader_files(ckp_dir)
+        # 0 on-disk files is legal: the loader resumes from its own
+        # newest auto-save dir, not necessarily this model checkpoint
+        if 0 < found < old_lw:
+            problems.append(
+                f"checkpoint {ckp_dir} holds {found} loader_state file(s) "
+                f"but was written by {old_lw} loader rank(s); an elastic "
+                f"resume needs every per-rank file to reassemble the "
+                f"document walk — the checkpoint copy is incomplete"
+            )
+
+    old_rows = int(old.get("global_batch_rows") or 0)
+    new_rows = int(new.get("global_batch_rows") or 0)
+    if old_rows and new_rows and old_rows != new_rows and not allow_batch_change:
+        problems.append(
+            f"global batch would change across the rescale "
+            f"({old_rows} -> {new_rows} rows), shifting tokens_seen, the "
+            f"LR schedule, and the loss trajectory. Set --batch_size so "
+            f"per-rank rows x data-parallel extent = {old_rows}, or pass "
+            f"--allow_batch_change=True to accept the change"
+        )
+
+    old_seq = int(old.get("seq_length") or 0)
+    new_seq = int(new.get("seq_length") or 0)
+    if old_seq and new_seq and old_seq != new_seq and not allow_batch_change:
+        problems.append(
+            f"seq_length changed across the resume ({old_seq} -> "
+            f"{new_seq}): tokens-per-step and the packed loader stream "
+            f"both shift. Restart with --seq_length={old_seq}, or pass "
+            f"--allow_batch_change=True to accept the change"
+        )
+
+    return problems, changed

@@ -50,6 +50,7 @@ from typing import List, Optional
 
 import jax
 
+from fms_fsdp_tpu.ckpt.elastic import stamp_topology
 from fms_fsdp_tpu.utils.checkpointing import Checkpointer
 from fms_fsdp_tpu.utils.ckpt_paths import step_number
 
@@ -123,6 +124,30 @@ class AsyncCheckpointManager:
         self._bg_seconds = 0.0
         self._in_flight = 0
         self._pending_saves: list = []  # (tier_name, bytes, bg_s)
+        # elastic resume (ckpt/elastic.py): the live world's topology
+        # fingerprint, stamped into every tier's metadata.json and
+        # enforced by the tier Checkpointers' load gate
+        self.fingerprint: dict = None
+
+    def set_fingerprint(self, fingerprint, allow_batch_change: bool = False):
+        """Arm the elastic-resume contract on every tier (see
+        ``Checkpointer.set_fingerprint``)."""
+        self.fingerprint = dict(fingerprint) if fingerprint else None
+        for tier in self.tiers:
+            tier.ckp.set_fingerprint(fingerprint, allow_batch_change)
+
+    def resume_topology(self):
+        """Topology fingerprint of the newest committed checkpoint a
+        resume would restore, merged across tiers, or None. Rank 0's
+        scan is broadcast so every host resolves the same elastic batch
+        policy before building its loader."""
+        candidates = []
+        for tier in self.tiers:
+            candidates.extend(
+                tier.ckp._candidate_ckp_paths(tier.ckp.ckp_path)
+            )
+        candidates.sort(key=step_number, reverse=True)
+        return self.durable.ckp.resume_topology(candidates)
 
     # -- observability -----------------------------------------------------
 
@@ -222,6 +247,9 @@ class AsyncCheckpointManager:
 
             meta = dict(metadata)
             meta["step"] = step
+            # stamped on the main thread (the background writer must not
+            # guess whether a dataloader rode along)
+            stamp_topology(meta, self.fingerprint, dataloader)
             with self._lock:
                 self._in_flight = 1
             if self.async_save:
@@ -438,8 +466,18 @@ def build_checkpoint_manager(
             verify=verify,
         )
     )
-    return AsyncCheckpointManager(
+    mgr = AsyncCheckpointManager(
         tiers,
         async_save=bool(getattr(cfg, "ckpt_async", True)),
         rank=rank,
     )
+    # default elastic fingerprint from the config as given; the llama/
+    # mamba/mixtral entries re-stamp after the elastic batch policy has
+    # resolved the per-rank batch size (main_training_llama.main)
+    from fms_fsdp_tpu.ckpt.elastic import current_fingerprint
+
+    mgr.set_fingerprint(
+        current_fingerprint(cfg),
+        allow_batch_change=bool(getattr(cfg, "allow_batch_change", False)),
+    )
+    return mgr

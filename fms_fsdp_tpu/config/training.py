@@ -136,6 +136,13 @@ class TrainConfig:
     ckpt_local_dir: str = ""  # fast-tier root; "" disables the local tier
     ckpt_local_interval: int = 0  # steps between local-tier saves; 0 disables
     ckpt_local_keep: int = 2  # local-tier retention
+    # Elastic resume (docs/checkpointing.md "Elastic resume"): restarts
+    # on a different topology preserve the checkpoint's GLOBAL batch by
+    # recomputing per-rank rows; when the new data-parallel extent
+    # cannot divide it (or batch_size/seq_length were changed
+    # explicitly), the resume is a hard error unless this escape hatch
+    # accepts the shifted tokens-per-step / LR-schedule trajectory.
+    allow_batch_change: bool = False
 
     # profiling
     use_profiler: bool = False

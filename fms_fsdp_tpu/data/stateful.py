@@ -175,10 +175,19 @@ class StatefulDataset:
         assert not os.path.isfile(path), "Checkpoint should be a folder of shard states"
         fileshards = [x for x in os.listdir(path) if "loader" in x]
         fileshards = sorted(fileshards, key=lambda x: int(x.split("_")[2][:-4]))
-        assert len(fileshards) > 0, (
-            "Checkpoint directory must contain checkpoint files with 'loader'"
-            " in the name"
-        )
+        if not fileshards:
+            raise RuntimeError(
+                f"checkpoint {path} contains no loader_state files: the "
+                f"document-walk position cannot be restored. The "
+                f"checkpoint is either model-only (saved without a "
+                f"dataloader) or an incomplete copy — resume from a "
+                f"checkpoint holding every per-rank loader_state_<N>.pkl "
+                f"the save wrote."
+            )
+        # elastic resume: load_worldsize is the SAVE world (process
+        # count x num_workers then); when it differs from this world,
+        # each rank reads every old file that fractionally owns its
+        # logical shards and load_state_dict reshards (docs/dataloader.md)
         self.load_worldsize = len(fileshards)
         my_fileshards = shard_inclusive(fileshards, self.rank, self.worldsize)
         states = []

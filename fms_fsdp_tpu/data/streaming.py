@@ -426,11 +426,21 @@ class StreamingDocDataset(StatefulDataset):
 
     def load_state_dict(self, state_dicts, sharded_input=False):
         self.setup()
-        assert self.load_worldsize == self.worldsize, (
-            f"StreamingDocDataset does not support rescaling (ckp size: "
-            f"{self.load_worldsize}, world size: {self.worldsize}). "
-            "Please use a ScalableShardDataset."
-        )
+        if self.load_worldsize != self.worldsize:
+            # a real diagnostic, not a bare assert: this is where an
+            # illegal elastic resume lands when the checkpoint-side
+            # topology gate was bypassed (direct pipeline construction,
+            # hand-copied loader state)
+            raise RuntimeError(
+                f"StreamingDocDataset does not support rescaling: the "
+                f"checkpoint holds {self.load_worldsize} reader state(s) "
+                f"but this world expects {self.worldsize}. A bare reader "
+                f"resumes only at its save world size — wrap it in "
+                f"ScalableShardDataset (n_logical_shards divisible by "
+                f"every process x worker product you may restart on, "
+                f"the production get_data_loader layout), or restart "
+                f"with the original world size."
+            )
         d = self.dataset
         # this run's own setup-time probe failures, before the restored
         # state overwrites the attribute
@@ -534,6 +544,17 @@ class ScalableShardDataset(WrapperDataset):
         if self.is_setup:
             return
         StatefulDataset.setup(self)
+        if self.total_shards % self.worldsize != 0:
+            # checked at setup (not just __init__) because the loader's
+            # worker inflation multiplies worldsize after construction
+            raise RuntimeError(
+                f"n_logical_shards {self.total_shards} is not divisible "
+                f"by the loader world size {self.worldsize} (= process "
+                f"count x num_workers): logical shards cannot be "
+                f"partitioned evenly. Adjust --logical_shards or "
+                f"--num_workers (or the host count) so the product "
+                f"divides {self.total_shards}."
+            )
         logicals = list(range(self.total_shards))
         self.logicals_owned = shard_partition(logicals, self.rank, self.worldsize)
         self.n_logicals = self.total_shards // self.worldsize

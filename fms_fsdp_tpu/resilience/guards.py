@@ -78,17 +78,33 @@ class StepWatchdog:
     goodput}); the stall report quotes its last contents so the
     post-mortem states exactly how far the run got and how healthy it
     was when it wedged. External orchestrators poll the same file.
+
+    ``process_index`` (optional) is the host's ``jax.process_index()``,
+    passed in by the trainer at construction — the stall path must not
+    import or call into jax from the watchdog thread of a wedged
+    process — so merged multi-host logs attribute WHICH host's stacks
+    are being read.
     """
 
     EXIT_CODE = 2
 
     def __init__(
-        self, timeout_s: float, poll_s: float = None, heartbeat_path=None
+        self,
+        timeout_s: float,
+        poll_s: float = None,
+        heartbeat_path=None,
+        process_index=None,
     ):
         assert timeout_s > 0
         self.timeout_s = timeout_s
         self.poll_s = min(1.0, timeout_s / 4) if poll_s is None else poll_s
         self.heartbeat_path = heartbeat_path
+        self.process_index = process_index
+        self._tag = (
+            "step watchdog"
+            if process_index is None
+            else f"step watchdog [proc {process_index}]"
+        )
         self._last_beat = time.monotonic()
         self._paused = 0
         self._stop = threading.Event()
@@ -129,7 +145,7 @@ class StepWatchdog:
             stalled = time.monotonic() - self._last_beat
             if stalled > self.timeout_s:
                 sys.stderr.write(
-                    f"step watchdog: no training progress for "
+                    f"{self._tag}: no training progress for "
                     f"{stalled:.1f}s (timeout {self.timeout_s}s); dumping "
                     f"stacks and exiting {self.EXIT_CODE}\n"
                 )
@@ -143,7 +159,7 @@ class StepWatchdog:
                     except (OSError, ValueError):
                         hb = None
                     sys.stderr.write(
-                        f"step watchdog: last heartbeat "
+                        f"{self._tag}: last heartbeat "
                         f"({self.heartbeat_path}): {hb}\n"
                     )
                 sys.stderr.flush()

@@ -121,6 +121,31 @@ def load_params_only(load_path: str, init_params_fn):
     return restored["params"]
 
 
+def scan_topology(candidates, verify=True):
+    """Topology fingerprint stamped into the newest loadable checkpoint
+    in ``candidates`` (a newest-first ``_candidate_ckp_paths`` list), or
+    None. Single-file checkpoints carry no metadata; a torn
+    ``metadata.json`` or (with ``verify``) a manifest-verification
+    failure falls through to the next candidate — the same fallback
+    chain ``load`` walks, so the batch policy decided from this scan
+    matches the checkpoint a restore will actually read (a corrupt
+    newest checkpoint with intact metadata must not set a policy the
+    restore's fallback then contradicts)."""
+    from fms_fsdp_tpu.resilience.integrity import verify_manifest
+
+    for cand in candidates:
+        if os.path.isfile(cand):
+            break  # single-file checkpoints carry no metadata
+        if verify and not verify_manifest(cand)[0]:
+            continue  # load() will reject it and fall back too
+        try:
+            with open(os.path.join(cand, "metadata.json")) as f:
+                return json.load(f).get("topology")
+        except (OSError, ValueError):
+            continue  # torn metadata: the next candidate may do
+    return None
+
+
 def _merge_trees(target, loaded, strict: bool):
     """Overlay ``loaded`` onto ``target``. strict=True requires identical
     structure; strict=False takes matching keys and keeps target leaves for
@@ -172,6 +197,13 @@ class Checkpointer:
         # loader-only prune candidates awaiting quiescence: path ->
         # (newest mtime when marked, local time when marked)
         self._prune_marks: dict = {}
+        # elastic resume (ckpt/elastic.py): the live world's topology
+        # fingerprint, stamped into every metadata.json by save() and
+        # checked against the checkpoint's stamp by load(). None (the
+        # default for direct constructions) stamps nothing and skips the
+        # gate — the entry points always set one via set_fingerprint.
+        self.fingerprint: dict = None
+        self.allow_batch_change = False
 
         import orbax.checkpoint as ocp
 
@@ -183,6 +215,74 @@ class Checkpointer:
             print(*args)
             for k, v in kwargs.items():
                 print(k, "=", v)
+
+    def set_fingerprint(self, fingerprint, allow_batch_change: bool = False):
+        """Arm the elastic-resume contract: ``fingerprint`` (a
+        ``ckpt/elastic.py`` topology dict for the LIVE world) is stamped
+        into every save's metadata.json and compared against the
+        checkpoint's stamp on load — a mismatch is validated for rescale
+        legality before any collective restore."""
+        self.fingerprint = dict(fingerprint) if fingerprint else None
+        self.allow_batch_change = bool(allow_batch_change)
+
+    def resume_topology(self, candidates=None):
+        """Topology fingerprint stamped into the checkpoint a resume
+        from the save dir would restore, or None (fresh start, legacy
+        checkpoint, or single-file checkpoint). Multi-host runs
+        broadcast rank 0's read so every host resolves the same elastic
+        batch policy before building its loader. ``candidates`` lets
+        the multi-tier manager pass its cross-tier merged newest-first
+        list instead of this Checkpointer's own save dir."""
+        if candidates is None:
+            candidates = self._candidate_ckp_paths(self.ckp_path)
+        topo = scan_topology(candidates, verify=self.verify)
+        if jax.process_count() > 1:
+            topo = self._broadcast_obj({"topo": topo})["topo"]
+        return topo
+
+    def _elastic_gate(self, load_path, meta):
+        """Validate the checkpoint's topology stamp against the live
+        fingerprint BEFORE the collective restore: an illegal rescale
+        must fail fast with the same actionable error on every host —
+        never deadlock half the pod inside Orbax, and never walk a
+        silently shifted document stream. No-op (bit-identical to the
+        pre-elastic behavior) when topologies match, when either side
+        carries no fingerprint, or on single-file checkpoints."""
+        from fms_fsdp_tpu.ckpt.elastic import check_rescale, describe_change
+
+        if self.fingerprint is None:
+            return
+        topo = (meta or {}).get("topology")
+        if topo is None:
+            self.report(
+                f"Note: checkpoint {load_path} predates topology "
+                f"fingerprints; skipping the elastic-resume "
+                f"compatibility check."
+            )
+            return
+        problems, changed = check_rescale(
+            topo,
+            self.fingerprint,
+            ckp_dir=load_path,
+            allow_batch_change=self.allow_batch_change,
+        )
+        # collective verdict: the loader-file count is a local listdir
+        # that eventually-consistent storage could split across hosts,
+        # and every host must either proceed into the collective
+        # restore or raise — never a mixture
+        if not self._all_agree(not problems):
+            raise RuntimeError(
+                f"elastic resume from {load_path} is not legal for this "
+                f"world ({describe_change(topo, self.fingerprint) or 'peer report'}):\n- "
+                + "\n- ".join(problems or ["a peer process rejected the rescale"])
+            )
+        if changed:
+            self.report(
+                f"Elastic resume: restart topology differs from the "
+                f"save topology ({describe_change(topo, self.fingerprint)}); "
+                f"model/optimizer reshard onto the live mesh and loader "
+                f"state reshards across the new ranks."
+            )
 
     # -- path resolution ----------------------------------------------------
 
@@ -424,6 +524,8 @@ class Checkpointer:
         a committed checkpoint always has a verifiable manifest."""
         from contextlib import nullcontext
 
+        # function-level: ckpt/__init__ -> manager -> this module
+        from fms_fsdp_tpu.ckpt.elastic import stamp_topology
         from fms_fsdp_tpu.resilience.integrity import write_manifest
 
         obs = self.observer
@@ -441,6 +543,7 @@ class Checkpointer:
             if self.rank == 0:
                 write_manifest(save_name)
                 metadata["step"] = step
+                stamp_topology(metadata, self.fingerprint, dataloader)
                 meta_path = os.path.join(save_name, "metadata.json")
                 with open(meta_path + ".tmp", "w") as f:
                     json.dump(metadata, f)
@@ -616,6 +719,35 @@ class Checkpointer:
                 if problems:  # legacy pre-manifest checkpoint
                     self.report(f"Note: {problems[0]}")
 
+            # metadata is read BEFORE the collective restore: a torn
+            # metadata.json is a corrupt checkpoint (fall back while
+            # falling back is still collective-safe), and the elastic
+            # topology gate below must be able to fail fast on every
+            # host rather than deadlock half the pod inside Orbax
+            meta = None
+            if is_resuming and not reset_stepcount:
+                meta_err = None
+                try:
+                    with open(os.path.join(load_path, "metadata.json")) as f:
+                        meta = json.load(f)
+                except (OSError, ValueError) as e:
+                    meta_err = e
+                if not self._all_agree(meta_err is None):
+                    self.report(
+                        f"WARNING: checkpoint {load_path} has an "
+                        f"unreadable metadata.json on at least one "
+                        f"process ({meta_err}); falling back to the "
+                        f"next-newest committed checkpoint."
+                    )
+                    last_err = meta_err or RuntimeError(
+                        f"peer process failed to read metadata of {load_path}"
+                    )
+                    continue
+                # elastic gate: same-topology resumes pass through
+                # untouched; a topology change is validated for rescale
+                # legality (illegal -> actionable raise on every host)
+                self._elastic_gate(load_path, meta)
+
             # sharded directory checkpoint: restore into the target sharding
             abstract = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(
@@ -627,12 +759,6 @@ class Checkpointer:
                 restored = self._ckptr.restore(
                     os.path.join(load_path, "state"), abstract
                 )
-                meta = None
-                if is_resuming and not reset_stepcount:
-                    # read metadata inside the fallback scope: a torn
-                    # metadata.json is a corrupt checkpoint too
-                    with open(os.path.join(load_path, "metadata.json")) as f:
-                        meta = json.load(f)
                 if dataloader is not None:
                     # loader state is per-rank and excluded from the
                     # manifest (another host may still be writing its

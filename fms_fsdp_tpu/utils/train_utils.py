@@ -335,9 +335,10 @@ def _train_loop(
 
     window = []
     train_loss = -1.0
+    g_norm = -1.0
     start = time.time()
     loop_start = time.time()
-    new_tokens_seen = 0
+    batch_idx = start_step
     preemption = PreemptionGuard().install()
     guard = AnomalyGuard(
         max_consecutive=max(1, getattr(cfg, "anomaly_max_consecutive", 8))
@@ -346,7 +347,12 @@ def _train_loop(
     timeout_s = float(getattr(cfg, "step_timeout_s", 0.0) or 0.0)
     if timeout_s > 0:
         hb = observer.heartbeat.path if observer.heartbeat else None
-        watchdog = StepWatchdog(timeout_s, heartbeat_path=hb).start()
+        # rank (== jax.process_index() in the entries) is passed in so a
+        # multi-host stall report names its host without the wedged
+        # process having to touch jax from the watchdog thread
+        watchdog = StepWatchdog(
+            timeout_s, heartbeat_path=hb, process_index=rank
+        ).start()
 
     # phase instrumentation: data_wait at the loop's next(), compute at
     # step dispatch + the report-time fetch, checkpoint inside save()
@@ -354,9 +360,150 @@ def _train_loop(
     step_fn = wrap_step_fn(step_fn, observer.timer)
     checkpointer.observer = observer
 
+    def global_tokens(step):
+        """Tokens seen through ``step``, exact at any step — checkpoint
+        metadata must not reuse the last report's stale figure when a
+        preemption/final save lands mid-report-window."""
+        return tokens_seen + (
+            (step - start_step) * world_size * cfg.batch_size * cfg.seq_length
+        )
+
+    def flush_window(step, drain=False):
+        """Fetch + report the pending metric window (no-op when empty).
+
+        Called at every report boundary AND (``drain=True``) when the
+        loop exits mid-window (preemption, final step, exhausted
+        loader): the tail steps' non-finite flags must reach
+        ``guard.observe`` — otherwise the final record under-counts
+        skipped_steps_total and a bad streak spanning the exit is
+        invisible — and the tail's metrics must land in one last record
+        before the final save stamps the guard's totals into checkpoint
+        metadata. Boundary prints keep the reference's fixed
+        report_interval divisor (ref parity, even for a resume's partial
+        first window); drain windows are new output with no reference
+        counterpart, so their printed rates use the true step count —
+        the exit lines an operator reads must not inflate throughput by
+        report_interval/len(window)."""
+        nonlocal window, start, train_loss, g_norm
+        if not window:
+            return
+        # one host sync per report interval. This device_get is where a
+        # stuck collective actually manifests (the loop only
+        # dispatches), so the watchdog timeout must cover a FULL report
+        # window of steps — see the step_timeout_s sizing note in
+        # config/training.py.
+        with observer.phase("compute"):
+            fetched = jax.device_get(window)
+        if watchdog:
+            watchdog.beat()
+        window = []
+        # anomaly accounting: per-step non-finite flags in step order
+        # (updates for flagged steps were already skipped on device);
+        # report means over the clean steps only so one NaN doesn't
+        # poison the whole window's loss
+        flags = [float(m.pop("nonfinite", 0.0)) for m in fetched]
+        window_skips = guard.observe(flags)
+        good = [m for m, f in zip(fetched, flags) if not f]
+        # a fully-poisoned window (every step non-finite) has no finite
+        # loss to state: carry the last clean loss/gnorm instead of
+        # averaging NaN into the print stream, and mark the record
+        # (loss=null in sinks, window_poisoned in extra) — skipped_
+        # steps_window == steps tells the story
+        poisoned = not good
+        if not poisoned:
+            train_loss = float(sum(m["loss"] for m in good) / len(good))
+            g_norm = float(sum(m["gnorm"] for m in good) / len(good))
+        current_lr = float(fetched[-1]["lr"])
+        # any extra model-family metrics (e.g. MoE moe_drop_frac)
+        extra_metrics = (
+            {}
+            if poisoned
+            else {
+                k: float(sum(m[k] for m in good) / len(good))
+                for k in good[-1]
+                if k not in ("loss", "gnorm", "lr")
+            }
+        )
+        elapsed_time = time.time() - loop_start
+        new_tokens_seen = (
+            (step - start_step) * world_size * cfg.batch_size * cfg.seq_length
+        )
+        total_tokens_seen = tokens_seen + new_tokens_seen
+        window_wall = time.time() - start
+        current_step_time = window_wall / (
+            len(fetched) if drain else cfg.report_interval
+        )
+        overall_step_time = elapsed_time / max(1, step - start_step)
+        current_throughput = int(
+            cfg.batch_size * cfg.seq_length / current_step_time
+        )
+        overall_throughput = int(
+            cfg.batch_size * cfg.seq_length / overall_step_time
+        )
+        reserved_mem, allocated_mem = _memory_stats()
+        if rank == 0:
+            if poisoned:
+                print(
+                    f"report window poisoned: all {len(fetched)} step(s) "
+                    f"non-finite; carrying last clean loss"
+                )
+            print("step:", step)
+            print("loss:", train_loss)
+            print("LR:", current_lr)
+            print("tokens seen:", total_tokens_seen)
+            print("gradient norm:", g_norm)
+            print("reserved memory:", reserved_mem)
+            print("allocated memory:", allocated_mem)
+            print("current step time:", current_step_time)
+            print("overall step time:", overall_step_time)
+            print("current token per chip per sec:", current_throughput)
+            print("overall token per chip per sec:", overall_throughput)
+            print(
+                "overall token per day:",
+                int(new_tokens_seen / elapsed_time * 3600 * 24),
+            )
+            if guard.skipped_batches:
+                print("skipped batches:", guard.skipped_batches)
+            for k, v in extra_metrics.items():
+                print(f"{k}:", v)
+        # structured record: every sink (JSONL/CSV file sinks, the
+        # legacy wandb/aim tracker adapter), goodput/MFU derivation, and
+        # the heartbeat hang off this one call; non-zero ranks run it
+        # too (no sinks — it closes their phase window so timing stays
+        # rank-consistent). Rates are derived from the window's TRUE
+        # step count (a resume's first window and an exit-drain window
+        # are partial — len(fetched) < report_interval — and the printed
+        # per-interval numbers inherit the reference's fixed divisor) so
+        # the persistent record never inflates throughput/MFU.
+        window_steps = max(1, len(fetched))
+        obs_step_time = max(1e-9, window_wall) / window_steps
+        record_extra = dict(extra_metrics)
+        if poisoned:
+            record_extra["window_poisoned"] = 1
+        observer.report(
+            step,
+            len(fetched),
+            loss=float("nan") if poisoned else train_loss,
+            grad_norm=float("nan") if poisoned else g_norm,
+            learning_rate=current_lr,
+            tokens_seen=total_tokens_seen,
+            tokens_per_sec_per_chip=(
+                cfg.batch_size * cfg.seq_length / obs_step_time
+            ),
+            tokens_per_sec_per_chip_overall=overall_throughput,
+            step_time_s=obs_step_time,
+            skipped_steps_total=guard.skipped_batches,
+            skipped_steps_window=window_skips,
+            memory_reserved_bytes=reserved_mem,
+            memory_allocated_bytes=allocated_mem,
+            extra=record_extra,
+        )
+        start = time.time()
+
     try:
         for batch_idx, batch in enumerate(train_loader, start=start_step + 1):
             if batch_idx > cfg.num_steps:
+                batch_idx -= 1  # this batch was never trained on
                 break
             if watchdog:
                 watchdog.beat()
@@ -367,105 +514,7 @@ def _train_loop(
                 profiler.step()
 
             if batch_idx % cfg.report_interval == 0:
-                # one host sync per report interval. This device_get is
-                # where a stuck collective actually manifests (the loop
-                # above only dispatches), so the watchdog timeout must
-                # cover a FULL report window of steps — see the
-                # step_timeout_s sizing note in config/training.py.
-                with observer.phase("compute"):
-                    fetched = jax.device_get(window)
-                if watchdog:
-                    watchdog.beat()
-                window = []
-                # anomaly accounting: per-step non-finite flags in step
-                # order (updates for flagged steps were already skipped
-                # on device); report means over the clean steps only so
-                # one NaN doesn't poison the whole window's loss
-                flags = [float(m.pop("nonfinite", 0.0)) for m in fetched]
-                window_skips = guard.observe(flags)
-                good = [m for m, f in zip(fetched, flags) if not f] or fetched
-                train_loss = float(
-                    sum(m["loss"] for m in good) / max(1, len(good))
-                )
-                g_norm = float(
-                    sum(m["gnorm"] for m in good) / max(1, len(good))
-                )
-                current_lr = float(fetched[-1]["lr"])
-                # any extra model-family metrics (e.g. MoE moe_drop_frac)
-                extra_metrics = {
-                    k: float(sum(m[k] for m in good) / max(1, len(good)))
-                    for k in good[-1]
-                    if k not in ("loss", "gnorm", "lr")
-                }
-                elapsed_time = time.time() - loop_start
-                new_tokens_seen = (
-                    (batch_idx - start_step)
-                    * world_size
-                    * cfg.batch_size
-                    * cfg.seq_length
-                )
-                total_tokens_seen = tokens_seen + new_tokens_seen
-                window_wall = time.time() - start
-                current_step_time = window_wall / cfg.report_interval
-                overall_step_time = elapsed_time / (batch_idx - start_step)
-                current_throughput = int(
-                    cfg.batch_size * cfg.seq_length / current_step_time
-                )
-                overall_throughput = int(
-                    cfg.batch_size * cfg.seq_length / overall_step_time
-                )
-                reserved_mem, allocated_mem = _memory_stats()
-                if rank == 0:
-                    print("step:", batch_idx)
-                    print("loss:", train_loss)
-                    print("LR:", current_lr)
-                    print("tokens seen:", total_tokens_seen)
-                    print("gradient norm:", g_norm)
-                    print("reserved memory:", reserved_mem)
-                    print("allocated memory:", allocated_mem)
-                    print("current step time:", current_step_time)
-                    print("overall step time:", overall_step_time)
-                    print("current token per chip per sec:", current_throughput)
-                    print("overall token per chip per sec:", overall_throughput)
-                    print(
-                        "overall token per day:",
-                        int(new_tokens_seen / elapsed_time * 3600 * 24),
-                    )
-                    if guard.skipped_batches:
-                        print("skipped batches:", guard.skipped_batches)
-                    for k, v in extra_metrics.items():
-                        print(f"{k}:", v)
-                # structured record: every sink (JSONL/CSV file sinks,
-                # the legacy wandb/aim tracker adapter), goodput/MFU
-                # derivation, and the heartbeat hang off this one call;
-                # non-zero ranks run it too (no sinks — it closes their
-                # phase window so timing stays rank-consistent). Rates
-                # are derived from the window's TRUE step count (a
-                # resume's first window is partial — len(fetched) <
-                # report_interval — and the printed per-interval numbers
-                # inherit the reference's fixed divisor) so the
-                # persistent record never inflates throughput/MFU.
-                window_steps = max(1, len(fetched))
-                obs_step_time = max(1e-9, window_wall) / window_steps
-                observer.report(
-                    batch_idx,
-                    len(fetched),
-                    loss=train_loss,
-                    grad_norm=g_norm,
-                    learning_rate=current_lr,
-                    tokens_seen=total_tokens_seen,
-                    tokens_per_sec_per_chip=(
-                        cfg.batch_size * cfg.seq_length / obs_step_time
-                    ),
-                    tokens_per_sec_per_chip_overall=overall_throughput,
-                    step_time_s=obs_step_time,
-                    skipped_steps_total=guard.skipped_batches,
-                    skipped_steps_window=window_skips,
-                    memory_reserved_bytes=reserved_mem,
-                    memory_allocated_bytes=allocated_mem,
-                    extra=extra_metrics,
-                )
-                start = time.time()
+                flush_window(batch_idx)
 
                 if guard.should_abort():
                     # a poisoned data region or true divergence: skipping
@@ -478,7 +527,8 @@ def _train_loop(
                             state,
                             dataloader,
                             reason="abort",
-                            tokens_seen=tokens_seen + new_tokens_seen,
+                            tokens_seen=global_tokens(batch_idx),
+                            skipped_steps=guard.skipped_batches,
                         )
                     raise RuntimeError(
                         f"anomaly guard: {guard.consecutive} consecutive "
@@ -497,23 +547,30 @@ def _train_loop(
                 else batch_idx % cfg.checkpoint_interval == 0
             )
             if interval_due or batch_idx == cfg.num_steps or preempt_now:
-                # the watchdog deadline is sized for step windows; a
-                # healthy multi-minute Orbax save must not trip it, so
-                # the watchdog is suspended (and re-armed) around it.
-                # (Async saves only block for the snapshot here; the
-                # storage write runs on the background writer.)
                 reason = (
                     "preempt"
                     if preempt_now
                     else ("final" if batch_idx == cfg.num_steps else "interval")
                 )
+                if reason != "interval":
+                    # the loop is about to exit: drain the pending
+                    # window first so the guard's totals (stamped into
+                    # the save's metadata below) and the final record
+                    # cover the tail steps
+                    flush_window(batch_idx, drain=True)
+                # the watchdog deadline is sized for step windows; a
+                # healthy multi-minute Orbax save must not trip it, so
+                # the watchdog is suspended (and re-armed) around it.
+                # (Async saves only block for the snapshot here; the
+                # storage write runs on the background writer.)
                 with watchdog.paused() if watchdog else _nullctx():
                     checkpointer.save(
                         batch_idx,
                         state,
                         dataloader,
                         reason=reason,
-                        tokens_seen=tokens_seen + new_tokens_seen,
+                        tokens_seen=global_tokens(batch_idx),
+                        skipped_steps=guard.skipped_batches,
                     )
             if preempt_now:
                 if rank == 0:
@@ -522,6 +579,16 @@ def _train_loop(
                         f"step {batch_idx}, exiting clean"
                     )
                 break
+
+        # exhausted loader (finite stream) or num_steps overrun: drain
+        # whatever the last report window left pending (no-op when the
+        # exit landed on a report/save boundary)
+        flush_window(batch_idx, drain=True)
+        if guard.should_abort() and rank == 0:
+            print(
+                f"WARNING: run exited with {guard.consecutive} "
+                f"consecutive non-finite steps still streaking"
+            )
     finally:
         if watchdog:
             watchdog.stop()

@@ -18,7 +18,7 @@ import jax
 from fms_fsdp_tpu.config import TrainConfig
 from fms_fsdp_tpu.data import get_data_loader, get_dummy_loader
 from fms_fsdp_tpu.data.device_feed import DeviceFeed
-from fms_fsdp_tpu.data.loader import rebatch
+from fms_fsdp_tpu.data.loader import elastic_batch_size, rebatch
 from fms_fsdp_tpu.parallel.mesh import (
     MeshConfig,
     build_mesh,
@@ -71,6 +71,14 @@ def main(**kwargs):
     if rank == 0:
         print(f"\n--> model has {model_cfg.n_params() / 1e6} Million params\n")
 
+    # checkpoint manager BEFORE the dataloader: an elastic resume
+    # (restart on a different topology, docs/checkpointing.md "Elastic
+    # resume") must read the previous run's topology fingerprint and
+    # resolve the per-rank batch size that preserves the global batch
+    # before any per-rank row count is baked into the pipeline
+    checkpointer = build_checkpoint_manager(cfg, rank)
+    resume_topology = checkpointer.resume_topology()
+
     # dataloader: per-process stream; batches cover this process's slice of
     # the global batch (batch_size is per data-parallel rank, as in the
     # reference)
@@ -82,6 +90,18 @@ def main(**kwargs):
             f"positive multiple of process count {world_size}; lower "
             "tensor/context parallel sizes or add devices"
         )
+    if resume_topology:
+        cfg.batch_size = elastic_batch_size(
+            cfg, resume_topology, data_extent, rank
+        )
+    # (re)stamp the fingerprint with the RESOLVED batch size: this is
+    # what every save writes and what load validates rescales against
+    from fms_fsdp_tpu.ckpt.elastic import current_fingerprint
+
+    checkpointer.set_fingerprint(
+        current_fingerprint(cfg),
+        allow_batch_change=cfg.allow_batch_change,
+    )
     local_batch = cfg.batch_size * (data_extent // world_size)
     if not cfg.use_dummy_dataset:
         loader = get_data_loader(
@@ -104,10 +124,9 @@ def main(**kwargs):
     )
 
     # checkpoint load (continued pretraining or job restart): the async
-    # multi-tier manager (ckpt/) — blocking snapshot at the step
+    # multi-tier manager built above — blocking snapshot at the step
     # boundary, shard/manifest/commit on a background writer, optional
     # fast local tier alongside the durable one (docs/checkpointing.md)
-    checkpointer = build_checkpoint_manager(cfg, rank)
     state, _, start_step, tokens_seen, is_resuming = checkpointer.load(
         state,
         None,

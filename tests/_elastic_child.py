@@ -1,0 +1,222 @@
+"""Child process for tests/test_elastic.py: one rank of an N-process
+gloo CPU world driving the production llama training stack over real
+arrow data, with two observation hooks the elastic-resume contract needs:
+
+- STATE_HASH: a topology-independent digest of the restored train state
+  (every leaf all-gathered to full replication, then hashed in canonical
+  tree order) — two worlds restoring the same checkpoint must print the
+  same hash, whatever mesh each one built;
+- a document-walk log: each batch the TRAIN LOOP actually consumed has
+  its doc-marker tokens (values >= MARKER_BASE, one unique marker per
+  corpus document) appended to ``walk_dir/walk_<phase>_rank<r>.txt``.
+  Only trainer-consumed rows are logged — prefetched-but-unconsumed rows
+  are ahead of the checkpoint's loader state and legitimately reappear
+  after a resume, so logging them would fake replays.
+
+Env contract (set by the parent test): JAX_PLATFORMS=cpu, XLA_FLAGS with
+xla_force_host_platform_device_count=4, COORDINATOR_ADDRESS,
+NUM_PROCESSES, PROCESS_ID. argv: ckpt_dir data_path walk_dir phase
+num_steps ckpt_interval [faults].
+
+The orchestration mirrors main_training_llama.main (checkpoint manager
+BEFORE the loader, resume_topology -> elastic_batch_size ->
+set_fingerprint) but keeps the state handle so the restored hash can be
+printed before training continues.
+"""
+
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+MARKER_BASE = 1024
+
+
+def _state_hash(state, mesh):
+    """Digest of the full train state, independent of how it is sharded:
+    all-gather every leaf to replication, hash in canonical tree order."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    gathered = jax.jit(
+        lambda t: t, out_shardings=jax.tree.map(lambda _: rep, state)
+    )(state)
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_flatten_with_path(gathered)[0]
+    for path, leaf in leaves:
+        arr = np.asarray(leaf.addressable_data(0))
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _walk_logged(feed, walk_path):
+    """Yield from the device feed, logging every doc-marker token of the
+    rows this process holds (its addressable shards) for each batch the
+    train loop consumes. Rows reconstruct the packed line exactly:
+    input + label[-1] (causal_lm: input = line[:-1], label = line[1:])."""
+    with open(walk_path, "a") as f:
+        for batch in feed:
+            x, y = batch
+            seen = {}
+            for xs, ys in zip(x.addressable_shards, y.addressable_shards):
+                seen[str(xs.index)] = (
+                    np.asarray(xs.data), np.asarray(ys.data)
+                )
+            for xr, yr in seen.values():
+                full = np.concatenate([xr, yr[:, -1:]], axis=1)
+                for m in full[full >= MARKER_BASE]:
+                    f.write(f"{int(m)}\n")
+            f.flush()
+            yield batch
+
+
+def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval, faults):
+    import jax
+
+    from fms_fsdp_tpu.ckpt import build_checkpoint_manager
+    from fms_fsdp_tpu.ckpt.elastic import current_fingerprint
+    from fms_fsdp_tpu.config import TrainConfig
+    from fms_fsdp_tpu.data import get_data_loader
+    from fms_fsdp_tpu.data.device_feed import DeviceFeed
+    from fms_fsdp_tpu.data.loader import elastic_batch_size, rebatch
+    from fms_fsdp_tpu.parallel.mesh import (
+        MeshConfig,
+        build_mesh,
+        data_parallel_extent,
+    )
+    from fms_fsdp_tpu.train.step import (
+        init_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+    from fms_fsdp_tpu.utils.config_utils import (
+        get_model_config,
+        update_config,
+    )
+    from fms_fsdp_tpu.utils.train_utils import (
+        setup,
+        setup_environ_flags,
+        train,
+    )
+
+    cfg = TrainConfig()
+    update_config(
+        cfg,
+        use_dummy_dataset=False,
+        data_path=data_path,
+        datasets="dataset_1",
+        weights="1",
+        file_type="arrow",
+        logical_shards=8,
+        num_workers=1,
+        seq_length=64,
+        vocab_size=2048,
+        batch_size=2,
+        num_steps=num_steps,
+        report_interval=2,
+        checkpoint_interval=ckpt_interval,
+        sharding_strategy="fsdp",
+        ckpt_save_path=ckpt_dir,
+        ckpt_load_path=ckpt_dir,
+        faults=faults,
+    )
+    if cfg.faults:
+        from fms_fsdp_tpu.resilience.faults import configure_faults
+
+        configure_faults(cfg.faults)
+
+    setup()
+    setup_environ_flags()
+    rank = jax.process_index()
+    world_size = jax.process_count()
+
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    data_extent = data_parallel_extent(mesh)
+
+    model_cfg = get_model_config("llama2_7b")
+    update_config(
+        model_cfg,
+        **{
+            "LlamaConfig.nlayers": 2,
+            "LlamaConfig.emb_dim": 128,
+            "LlamaConfig.nheads": 4,
+            "LlamaConfig.kvheads": 2,
+            "LlamaConfig.src_vocab_size": 2048,
+            "LlamaConfig.multiple_of": 16,
+            "LlamaConfig.max_expected_seq_len": 64,
+        },
+    )
+
+    # same ordering as main_training_llama.main: manager first, elastic
+    # batch policy from the stamped topology, fingerprint re-stamped
+    # with the resolved batch size
+    checkpointer = build_checkpoint_manager(cfg, rank)
+    resume_topology = checkpointer.resume_topology()
+    if resume_topology:
+        cfg.batch_size = elastic_batch_size(
+            cfg, resume_topology, data_extent, rank
+        )
+    checkpointer.set_fingerprint(
+        current_fingerprint(cfg), allow_batch_change=cfg.allow_batch_change
+    )
+
+    local_batch = cfg.batch_size * (data_extent // world_size)
+    loader = get_data_loader(
+        cfg, rank, world_size, batch_multiplier=data_extent // world_size
+    )
+
+    optimizer = make_optimizer(cfg)
+    state, _ = init_train_state(
+        jax.random.PRNGKey(cfg.seed), model_cfg, cfg, mesh, optimizer
+    )
+    state, _, start_step, tokens_seen, is_resuming = checkpointer.load(
+        state,
+        None,
+        path=os.path.join(cfg.ckpt_load_path, "checkpoints/"),
+        strict=False,
+    )
+    if not is_resuming:
+        start_step = 0
+    print("START_STEP", start_step, flush=True)
+    print("TOKENS_SEEN", tokens_seen, flush=True)
+    print("STATE_HASH", _state_hash(state, mesh), flush=True)
+
+    if num_steps > start_step:
+        step_fn = make_train_step(model_cfg, cfg, mesh, optimizer)
+        feed = DeviceFeed(
+            rebatch(loader, local_batch, cfg.batch_size), mesh, prefetch=2
+        )
+        walk_path = os.path.join(walk_dir, f"walk_{phase}_rank{rank}.txt")
+        os.makedirs(walk_dir, exist_ok=True)
+        train(
+            cfg,
+            state,
+            step_fn,
+            rank,
+            _walk_logged(iter(feed), walk_path),
+            None,
+            checkpointer,
+            start_step,
+            tokens_seen,
+            dataloader=loader,
+            model_cfg=model_cfg,
+        )
+    print("ELASTIC_CHILD_DONE", flush=True)
+
+
+if __name__ == "__main__":
+    run(
+        sys.argv[1],
+        sys.argv[2],
+        sys.argv[3],
+        sys.argv[4],
+        int(sys.argv[5]),
+        int(sys.argv[6]),
+        sys.argv[7] if len(sys.argv) > 7 else "",
+    )

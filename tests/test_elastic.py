@@ -1,0 +1,625 @@
+"""Elastic resume: survive preemption and restart on a different topology.
+
+Three layers of coverage (docs/checkpointing.md "Elastic resume"):
+
+- fingerprint contract: every checkpoint's metadata.json carries the
+  save-time topology; the load gate validates rescale legality BEFORE
+  any collective restore, with actionable errors (and a pinned digest so
+  the field set can't drift silently);
+- data layer: a mid-epoch save at world 2 restores at world 1 and 4 with
+  the global document walk a seamless continuation — every document of
+  the epoch seen exactly once across the boundary (no replay, no skip);
+- e2e (slow, gloo multi-process — pattern from test_multiprocess.py):
+  train at world=2 over real arrow data, save (including a kill mid
+  async commit via the ckpt_precommit_kill fault site), resume at
+  world=1 and world=4 — params restore bit-identically onto the new
+  mesh (topology-independent state hash), the global batch is preserved
+  (per-rank rows recomputed), and the trainer-consumed document stream
+  never replays a document across the boundary.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "_elastic_child.py")
+
+MARKER_BASE = 1024  # keep in sync with tests/_elastic_child.py
+
+
+# ---- fingerprint contract --------------------------------------------------
+
+
+def _fp(**over):
+    fp = {
+        "process_count": 2,
+        "device_count": 8,
+        "tensor_parallel_size": 1,
+        "context_parallel_size": 1,
+        "global_batch_rows": 16,
+        "seq_length": 64,
+        "n_logical_shards": 8,
+        "loader_files": 2,
+    }
+    fp.update(over)
+    return fp
+
+
+def test_topology_digest_pinned():
+    """The fingerprint field set is a cross-run contract (old
+    checkpoints are read by new code): changing it must bump
+    TOPOLOGY_VERSION and pin the new digest — same guard as the obs
+    metric schema."""
+    from fms_fsdp_tpu.ckpt.elastic import (
+        TOPOLOGY_DIGESTS,
+        TOPOLOGY_VERSION,
+        topology_digest,
+    )
+
+    assert TOPOLOGY_DIGESTS.get(TOPOLOGY_VERSION) == topology_digest(), (
+        f"topology fingerprint changed without a version bump: pin "
+        f"{topology_digest()} for version {TOPOLOGY_VERSION}"
+    )
+
+
+def test_check_rescale_same_topology_is_noop():
+    from fms_fsdp_tpu.ckpt.elastic import check_rescale
+
+    problems, changed = check_rescale(_fp(), _fp())
+    assert problems == [] and changed is False
+
+
+def test_check_rescale_legal_change_detected():
+    from fms_fsdp_tpu.ckpt.elastic import check_rescale
+
+    # 2 hosts -> 1 host, global batch preserved, loader world divides
+    new = _fp(process_count=1, device_count=4, loader_files=1)
+    problems, changed = check_rescale(_fp(), new)
+    assert problems == [] and changed is True
+
+
+def test_check_rescale_nondividing_loader_world():
+    from fms_fsdp_tpu.ckpt.elastic import check_rescale
+
+    new = _fp(process_count=3, device_count=12, loader_files=3)
+    problems, _ = check_rescale(_fp(), new, allow_batch_change=True)
+    assert any("does not divide n_logical_shards" in p for p in problems)
+
+
+def test_check_rescale_changed_logical_shards():
+    from fms_fsdp_tpu.ckpt.elastic import check_rescale
+
+    problems, _ = check_rescale(_fp(), _fp(n_logical_shards=6))
+    assert any("--logical_shards=8" in p for p in problems)
+
+
+def test_check_rescale_batch_change_needs_flag():
+    from fms_fsdp_tpu.ckpt.elastic import check_rescale
+
+    new = _fp(global_batch_rows=8)
+    problems, _ = check_rescale(_fp(), new)
+    assert any("allow_batch_change" in p for p in problems)
+    problems, changed = check_rescale(_fp(), new, allow_batch_change=True)
+    assert problems == [] and changed is True
+
+
+def test_check_rescale_missing_loader_files(tmp_path):
+    from fms_fsdp_tpu.ckpt.elastic import check_rescale
+
+    (tmp_path / "loader_state_0.pkl").write_bytes(b"x")
+    new = _fp(process_count=1, device_count=4, loader_files=1)
+    old = _fp(loader_files=4)  # saved by 4 loader ranks, only 1 on disk
+    problems, _ = check_rescale(old, new, ckp_dir=str(tmp_path))
+    assert any("incomplete" in p for p in problems)
+
+
+def test_elastic_batch_size_policy(capsys):
+    from fms_fsdp_tpu.config import TrainConfig
+    from fms_fsdp_tpu.data.loader import elastic_batch_size
+
+    cfg = TrainConfig(batch_size=2)
+    # fresh start / same global batch: untouched
+    assert elastic_batch_size(cfg, None, 8) == 2
+    assert elastic_batch_size(cfg, {"global_batch_rows": 16}, 8) == 2
+    # halved extent: per-rank rows double to preserve the global batch
+    assert elastic_batch_size(cfg, {"global_batch_rows": 16}, 4) == 4
+    assert "preserving the global batch" in capsys.readouterr().out
+    # non-dividing extent: hard error naming the escape hatch
+    with pytest.raises(ValueError, match="allow_batch_change"):
+        elastic_batch_size(cfg, {"global_batch_rows": 16}, 3)
+    # escape hatch: configured batch respected, loud warning
+    cfg.allow_batch_change = True
+    assert elastic_batch_size(cfg, {"global_batch_rows": 16}, 3) == 2
+    assert "changes the global batch" in capsys.readouterr().out
+
+
+# ---- checkpoint gate (single process, tiny states) -------------------------
+
+
+class _TwoRankLoaderStub:
+    """Writes the loader_state files a 2-rank save would have."""
+
+    def save_to_path(self, path):
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        for r in range(2):
+            with open(os.path.join(path, f"loader_state_{r}.pkl"), "wb") as f:
+                pickle.dump({"rank": r}, f)
+
+
+def _saved_ckpt(tmp_path, fingerprint=_fp(), with_loader=True):
+    import jax.numpy as jnp
+
+    from fms_fsdp_tpu.utils.checkpointing import Checkpointer
+
+    ck = Checkpointer(str(tmp_path), 3, "fsdp", rank=0)
+    if fingerprint is not None:
+        ck.set_fingerprint(fingerprint)
+    state = {"w": jnp.arange(4.0), "step": jnp.zeros((), jnp.int32)}
+    ck.save(
+        4,
+        state,
+        _TwoRankLoaderStub() if with_loader else None,
+        tokens_seen=44,
+    )
+    return state
+
+
+def _loader_ckp(tmp_path, fingerprint, allow_batch_change=False):
+    from fms_fsdp_tpu.utils.checkpointing import Checkpointer
+
+    msgs = []
+
+    def report(*a, **k):
+        msgs.append(" ".join(str(x) for x in a))
+
+    ck = Checkpointer(str(tmp_path), 3, "fsdp", rank=0, report_fn=report)
+    if fingerprint is not None:
+        ck.set_fingerprint(fingerprint, allow_batch_change=allow_batch_change)
+    return ck, msgs
+
+
+def test_same_topology_resume_is_silent_noop(tmp_path):
+    state = _saved_ckpt(tmp_path)
+    ck, msgs = _loader_ckp(tmp_path, _fp())
+    _, _, step, ntok, resuming = ck.load(state, None)
+    assert (step, ntok, resuming) == (4, 44, True)
+    assert not any("Elastic resume" in m for m in msgs)
+
+
+def test_legal_rescale_loads_with_notice(tmp_path):
+    state = _saved_ckpt(tmp_path)
+    new = _fp(process_count=1, device_count=4, loader_files=1)
+    ck, msgs = _loader_ckp(tmp_path, new)
+    _, _, step, _, resuming = ck.load(state, None)
+    assert (step, resuming) == (4, True)
+    assert any("Elastic resume" in m for m in msgs), msgs
+
+
+def test_illegal_rescale_fails_fast_with_actionable_error(tmp_path):
+    state = _saved_ckpt(tmp_path)
+    new = _fp(process_count=3, device_count=12, loader_files=3)
+    ck, _ = _loader_ckp(tmp_path, new, allow_batch_change=True)
+    with pytest.raises(RuntimeError, match="does not divide n_logical_shards"):
+        ck.load(state, None)
+
+
+def test_missing_loader_file_fails_fast(tmp_path):
+    state = _saved_ckpt(tmp_path)
+    victim = os.path.join(
+        str(tmp_path), "checkpoints", "step_4_ckp", "loader_state_1.pkl"
+    )
+    os.remove(victim)
+    new = _fp(process_count=1, device_count=4, loader_files=1)
+    ck, _ = _loader_ckp(tmp_path, new)
+    with pytest.raises(RuntimeError, match="incomplete"):
+        ck.load(state, None)
+
+
+def test_batch_change_blocked_without_flag(tmp_path):
+    state = _saved_ckpt(tmp_path)
+    new = _fp(process_count=1, device_count=4, loader_files=1,
+              global_batch_rows=4)
+    ck, _ = _loader_ckp(tmp_path, new)
+    with pytest.raises(RuntimeError, match="allow_batch_change"):
+        ck.load(state, None)
+    ck2, msgs = _loader_ckp(tmp_path, new, allow_batch_change=True)
+    _, _, step, _, _ = ck2.load(state, None)
+    assert step == 4
+
+
+def test_legacy_checkpoint_without_topology_loads(tmp_path):
+    state = _saved_ckpt(tmp_path, fingerprint=None)
+    ck, msgs = _loader_ckp(tmp_path, _fp())
+    _, _, step, _, resuming = ck.load(state, None)
+    assert (step, resuming) == (4, True)
+    assert any("predates topology fingerprints" in m for m in msgs)
+
+
+def test_resume_topology_skips_corrupt_newest_checkpoint(tmp_path):
+    """The batch-policy scan walks the same manifest-verified fallback
+    chain as load(): a corrupt newest checkpoint with an intact
+    metadata.json must not set a policy the restore then contradicts by
+    falling back to an older (differently-batched) checkpoint."""
+    import jax.numpy as jnp
+
+    from fms_fsdp_tpu.utils.checkpointing import Checkpointer
+
+    ck = Checkpointer(str(tmp_path), 3, "fsdp", rank=0)
+    state = {"w": jnp.arange(4.0), "step": jnp.zeros((), jnp.int32)}
+    ck.set_fingerprint(_fp(global_batch_rows=16))
+    ck.save(4, state, _TwoRankLoaderStub(), tokens_seen=44)
+    ck.set_fingerprint(_fp(global_batch_rows=32))
+    ck.save(8, state, _TwoRankLoaderStub(), tokens_seen=88)
+    assert ck.resume_topology()["global_batch_rows"] == 32
+    # truncate a manifest-covered payload file in the newest checkpoint,
+    # leaving its metadata.json intact (the ckpt_corrupt failure class;
+    # loader_state files are deliberately outside the manifest's scope)
+    import json
+
+    step8 = os.path.join(str(tmp_path), "checkpoints", "step_8_ckp")
+    with open(os.path.join(step8, "manifest.json")) as f:
+        covered = [
+            rel
+            for rel, size in json.load(f)["files"].items()
+            if size > 0
+        ]
+    victim = os.path.join(step8, sorted(covered)[0])
+    with open(victim, "rb+") as f:
+        f.truncate(os.path.getsize(victim) // 2)
+    # the scan now resolves the checkpoint load() will actually restore
+    assert ck.resume_topology()["global_batch_rows"] == 16
+
+
+def test_manager_stamps_topology_on_every_tier(tmp_path):
+    """Both async tiers stamp the fingerprint; resume_topology reads the
+    newest committed one back (the entry's elastic preflight)."""
+    import json
+
+    import jax.numpy as jnp
+
+    from fms_fsdp_tpu.ckpt.manager import (
+        AsyncCheckpointManager,
+        CheckpointTier,
+    )
+
+    tiers = [
+        CheckpointTier("local", str(tmp_path / "local"), 2, 2, "fsdp", rank=0),
+        CheckpointTier("durable", str(tmp_path / "dur"), 4, 3, "fsdp", rank=0),
+    ]
+    m = AsyncCheckpointManager(tiers, async_save=False, rank=0)
+    m.set_fingerprint(_fp())
+    state = {"w": jnp.arange(4.0)}
+    m.save(2, state, None, tokens_seen=2)  # local tier
+    m.save(4, state, None, tokens_seen=4)  # durable tier
+    m.finalize()
+    for root, step in ((tmp_path / "local", 2), (tmp_path / "dur", 4)):
+        meta = json.loads(
+            (root / "checkpoints" / f"step_{step}_ckp" / "metadata.json")
+            .read_text()
+        )
+        # no dataloader rode along: loader_files stamped 0
+        assert meta["topology"] == _fp(loader_files=0), meta
+    assert m.resume_topology() == _fp(loader_files=0)
+
+
+def test_streaming_rescale_error_is_actionable(tmp_path):
+    """The bare reader's no-rescale assert is a real diagnostic now."""
+    from fms_fsdp_tpu.data.handlers import ArrowHandler
+    from fms_fsdp_tpu.data.streaming import StreamingDocDataset
+
+    datadir = _id_corpus(tmp_path / "data")
+    ckdir = str(tmp_path / "bare_ckpt")
+    for rank in range(2):  # a 2-rank save of the bare reader
+        d = StreamingDocDataset(
+            os.path.join(datadir, "dataset_1"), rank, 2, ArrowHandler(), -1,
+            max_chunksize=1000,
+        )
+        d.save_to_path(ckdir)
+    d2 = StreamingDocDataset(
+        os.path.join(datadir, "dataset_1"), 0, 1, ArrowHandler(), -1,
+        max_chunksize=1000,
+    )
+    with pytest.raises(RuntimeError, match="ScalableShardDataset"):
+        d2.load_from_path(ckdir)
+
+
+# ---- document walk across a rescale (data layer) ---------------------------
+
+
+def _id_corpus(root, n_docs=100, doc_len=100):
+    """One shard of ``n_docs`` docs; doc i = [i*100 .. i*100+99], so the
+    first token identifies the document."""
+    root = str(root)
+    os.makedirs(os.path.join(root, "dataset_1"), exist_ok=True)
+    schema = pa.schema([pa.field("tokens", pa.uint32())])
+    with pa.ipc.new_file(
+        os.path.join(root, "dataset_1", "shard.arrow"), schema
+    ) as w:
+        for i in range(n_docs):
+            w.write(
+                pa.record_batch(
+                    [list(range(i * 100, i * 100 + doc_len))], schema
+                )
+            )
+    os.makedirs(os.path.join(root, "meta"), exist_ok=True)
+    with open(os.path.join(root, "meta", "combined_counts.csv"), "w") as f:
+        f.write("dataset/filename,documents,tokens\n")
+        f.write(f"/dataset_1/shard.arrow,{n_docs},{n_docs * doc_len}\n")
+    return root
+
+
+def _scalable(rank, worldsize, datadir):
+    from fms_fsdp_tpu.data.handlers import ArrowHandler
+    from fms_fsdp_tpu.data.streaming import (
+        ScalableShardDataset,
+        StreamingDocDataset,
+    )
+
+    return ScalableShardDataset(
+        StreamingDocDataset(
+            os.path.join(datadir, "dataset_1"), rank, worldsize,
+            ArrowHandler(), -1, max_chunksize=1000,
+        ),
+        -1,
+        n_logical_shards=8,
+    )
+
+
+@pytest.mark.parametrize("new_world", [1, 4])
+def test_document_walk_continues_across_rescale(tmp_path, new_world):
+    """Mid-epoch save at world 2 -> per-rank loader_state files ->
+    restore at world 1 / 4 -> finish the epoch: every document of the
+    epoch appears exactly once across the boundary. Exact coverage is
+    the no-replay AND no-skip proof in one (pigeonhole: 60 + 40 distinct
+    docs over a 100-doc epoch)."""
+    datadir = _id_corpus(tmp_path / "data")
+    ds = [_scalable(i, 2, datadir) for i in range(2)]
+    its = [iter(d) for d in ds]
+    seen_before = [int(next(its[0])[0]) for _ in range(25)]
+    seen_before += [int(next(its[1])[0]) for _ in range(35)]
+    ckdir = str(tmp_path / "loader_ckpt")
+    for d in ds:
+        d.save_to_path(ckdir)
+
+    ds2 = [_scalable(i, new_world, datadir) for i in range(new_world)]
+    seen_after = []
+    for d in ds2:
+        d.load_from_path(ckdir)
+        remaining = sum(d.n_docs_remaining)
+        it = iter(d)
+        seen_after += [int(next(it)[0]) for _ in range(remaining)]
+
+    walk = sorted(seen_before + seen_after)
+    assert walk == [i * 100 for i in range(100)], (
+        f"document walk shifted across the rescale: "
+        f"{len(seen_before)} + {len(seen_after)} docs, "
+        f"{len(set(walk))} distinct"
+    )
+
+
+# ---- e2e: gloo multi-process world, production stack -----------------------
+
+
+def _marked_corpus(root, n_shards=4, docs_per_shard=200, doc_len=40):
+    """Arrow corpus where doc d opens with the unique marker token
+    MARKER_BASE+d (body tokens stay below MARKER_BASE): any marker
+    appearing twice in the trainer-consumed stream is a replayed
+    document."""
+    root = str(root)
+    os.makedirs(os.path.join(root, "dataset_1"), exist_ok=True)
+    schema = pa.schema([pa.field("tokens", pa.uint32())])
+    rows = []
+    d = 0
+    for s in range(n_shards):
+        path = os.path.join(root, "dataset_1", f"shard_{s}.arrow")
+        with pa.ipc.new_file(path, schema) as w:
+            for _ in range(docs_per_shard):
+                body = [(d * 31 + j) % 997 + 1 for j in range(doc_len - 1)]
+                w.write(
+                    pa.record_batch([[MARKER_BASE + d] + body], schema)
+                )
+                d += 1
+        rows.append((f"/dataset_1/shard_{s}.arrow", docs_per_shard,
+                     docs_per_shard * doc_len))
+    os.makedirs(os.path.join(root, "meta"), exist_ok=True)
+    with open(os.path.join(root, "meta", "combined_counts.csv"), "w") as f:
+        f.write("dataset/filename,documents,tokens\n")
+        for name, docs, toks in rows:
+            f.write(f"{name},{docs},{toks}\n")
+    return root
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch_world(n_procs, argv, timeout=600):
+    """Run the elastic child on an n-process gloo world; returns
+    (returncodes, outputs)."""
+    port = _free_port()
+    procs = []
+    for pid in range(n_procs):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        )
+        if n_procs > 1:
+            env.update(
+                COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                NUM_PROCESSES=str(n_procs),
+                PROCESS_ID=str(pid),
+            )
+        else:
+            # a true single-process restart: no distributed world at all
+            for k in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID"):
+                env.pop(k, None)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-u", CHILD, *argv],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+                cwd=REPO,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    return [p.returncode for p in procs], outs
+
+
+def _grab(out, key):
+    for line in out.splitlines():
+        if line.startswith(key + " "):
+            return line.split(" ", 1)[1].strip()
+    raise AssertionError(f"{key} not found in:\n{out[-3000:]}")
+
+
+def _walk_markers(walk_dir, phase):
+    markers = []
+    for name in sorted(os.listdir(walk_dir)):
+        if name.startswith(f"walk_{phase}_"):
+            with open(os.path.join(walk_dir, name)) as f:
+                markers += [int(x) for x in f.read().split()]
+    return markers
+
+
+@pytest.mark.slow
+def test_elastic_resume_world2_to_world1(tmp_path):
+    """Train at world=2 on real arrow data, commit at step 4; a
+    same-topology resume is a fingerprint no-op; a world=1 resume
+    restores bit-identically onto the new mesh, preserves the global
+    batch (per-rank rows 2 -> 4), and continues the trainer-consumed
+    document stream with zero replayed documents."""
+    data = _marked_corpus(tmp_path / "data")
+    ckpt = str(tmp_path / "ckpt")
+    walk = str(tmp_path / "walk")
+    os.makedirs(walk)
+
+    rcs, outs = _launch_world(2, [ckpt, data, walk, "save", "4", "4"])
+    assert rcs == [0, 0], outs[0][-3000:] + outs[1][-3000:]
+
+    # same-topology restart: the fingerprint check is a no-op
+    rcs, outs_same = _launch_world(2, [ckpt, data, walk, "same", "4", "4"])
+    assert rcs == [0, 0], outs_same[0][-3000:] + outs_same[1][-3000:]
+    assert _grab(outs_same[0], "START_STEP") == "4"
+    assert "Elastic resume" not in outs_same[0], outs_same[0][-3000:]
+    ref_hash = _grab(outs_same[0], "STATE_HASH")
+    assert _grab(outs_same[1], "STATE_HASH") == ref_hash
+
+    # world=1 rescale: bit-identical restore, preserved global batch,
+    # seamless walk continuation
+    rcs, outs_r = _launch_world(1, [ckpt, data, walk, "resume", "8", "4"])
+    assert rcs == [0], outs_r[0][-4000:]
+    out = outs_r[0]
+    assert _grab(out, "START_STEP") == "4"
+    assert _grab(out, "STATE_HASH") == ref_hash, out[-3000:]
+    assert "preserving the global batch of 16 rows" in out, out[-3000:]
+    assert "Elastic resume: restart topology differs" in out, out[-3000:]
+    losses = [
+        float(ln.split("loss:")[1].strip().split()[0])
+        for ln in out.splitlines()
+        if ln.startswith("loss:")
+    ]
+    assert losses and all(np.isfinite(losses)), out[-2000:]
+
+    before = _walk_markers(walk, "save")
+    after = _walk_markers(walk, "resume")
+    assert before and after, (len(before), len(after))
+    both = before + after
+    assert len(both) == len(set(both)), (
+        f"replayed documents across the rescale: "
+        f"{sorted(m for m in set(both) if both.count(m) > 1)[:10]}"
+    )
+
+
+@pytest.mark.slow
+def test_elastic_resume_world4_after_midsave_kill(tmp_path):
+    """The save world dies BETWEEN snapshot and commit at step 8 (the
+    PR 3 ckpt_precommit_kill site): step_8 is torn, step_4 committed. A
+    world=1 and a world=4 restart must both fall back to step 4 and
+    restore the identical state onto their different meshes."""
+    data = _marked_corpus(tmp_path / "data")
+    ckpt = str(tmp_path / "ckpt")
+    walk = str(tmp_path / "walk")
+    os.makedirs(walk)
+
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            NUM_PROCESSES="2",
+            PROCESS_ID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-u", CHILD, ckpt, data, walk, "save",
+                    "12", "4", "ckpt_precommit_kill:step=8",
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+                cwd=REPO,
+            )
+        )
+    try:
+        out0, _ = procs[0].communicate(timeout=600)
+        assert procs[0].returncode != 0, (
+            "rank 0 should die mid-commit\n" + out0[-3000:]
+        )
+    finally:
+        # rank 1 loses its peer mid-collective; reap it
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.communicate(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    ckdir = os.path.join(ckpt, "checkpoints")
+    entries = os.listdir(ckdir)
+    assert "step_4_ckp" in entries and "step_8_ckp" in entries, entries
+    assert "metadata.json" in os.listdir(os.path.join(ckdir, "step_4_ckp"))
+    assert "metadata.json" not in os.listdir(
+        os.path.join(ckdir, "step_8_ckp")
+    ), "step 8 should be uncommitted"
+
+    rcs, outs1 = _launch_world(1, [ckpt, data, walk, "cross", "4", "4"])
+    assert rcs == [0], outs1[0][-4000:]
+    assert _grab(outs1[0], "START_STEP") == "4"
+    h1 = _grab(outs1[0], "STATE_HASH")
+
+    rcs, outs4 = _launch_world(4, [ckpt, data, walk, "resume4", "8", "4"])
+    assert rcs == [0, 0, 0, 0], "\n".join(o[-2000:] for o in outs4)
+    assert _grab(outs4[0], "START_STEP") == "4"
+    for o in outs4:
+        assert _grab(o, "STATE_HASH") == h1, o[-3000:]
+    assert "ELASTIC_CHILD_DONE" in outs4[0]

@@ -454,7 +454,10 @@ def test_device_feed_finite_loader_terminates():
 
 def test_watchdog_stall_report_quotes_heartbeat(tmp_path):
     """A stalled run's watchdog post-mortem includes the last heartbeat
-    (how far the run got, how healthy it was) before exiting 2."""
+    (how far the run got, how healthy it was) before exiting 2 — and
+    every report line carries the host's process index (passed in at
+    construction, never fetched from jax on the wedged-process path) so
+    merged multi-host logs attribute WHICH host's stacks follow."""
     import subprocess
     import sys
 
@@ -466,7 +469,7 @@ def test_watchdog_stall_report_quotes_heartbeat(tmp_path):
         "from fms_fsdp_tpu.obs.sinks import Heartbeat\n"
         "from fms_fsdp_tpu.resilience.guards import StepWatchdog\n"
         "Heartbeat(%r).beat(123, 99.0, 0.5)\n"
-        "w = StepWatchdog(0.5, heartbeat_path=%r).start()\n"
+        "w = StepWatchdog(0.5, heartbeat_path=%r, process_index=3).start()\n"
         "w.beat()\n"
         "time.sleep(30)\n"
     ) % (repo, hb_path, hb_path)
@@ -477,8 +480,155 @@ def test_watchdog_stall_report_quotes_heartbeat(tmp_path):
         timeout=60,
     )
     assert proc.returncode == 2, (proc.returncode, proc.stderr[-1000:])
-    assert "last heartbeat" in proc.stderr, proc.stderr[-1000:]
+    assert "step watchdog [proc 3]: no training progress" in proc.stderr, (
+        proc.stderr[-1000:]
+    )
+    assert "step watchdog [proc 3]: last heartbeat" in proc.stderr, (
+        proc.stderr[-1000:]
+    )
     assert "'step': 123" in proc.stderr, proc.stderr[-1000:]
+
+
+# ---- hot-loop accounting (drives _train_loop with fakes) -------------------
+
+
+class _CaptureSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, rec):
+        self.records.append(rec)
+
+    def close(self):
+        pass
+
+
+class _FakeCheckpointer:
+    observer = None
+
+    def __init__(self):
+        self.saves = []
+
+    def save(self, step, state, dataloader=None, reason="interval", **md):
+        self.saves.append((step, reason, md))
+
+    def finalize(self):
+        pass
+
+
+def _drive_loop(
+    num_steps,
+    report_interval,
+    nonfinite_steps=(),
+    start_step=0,
+    step_sleep=0.0,
+    checkpoint_interval=10**9,
+):
+    """Run the real _train_loop over a fake step_fn/loader/checkpointer;
+    metrics are host floats so the report-time device_get is a no-op."""
+    import time as _time
+
+    from fms_fsdp_tpu.config import TrainConfig
+    from fms_fsdp_tpu.utils.train_utils import _train_loop
+
+    cfg = TrainConfig(
+        num_steps=num_steps,
+        report_interval=report_interval,
+        checkpoint_interval=checkpoint_interval,
+        batch_size=2,
+        seq_length=8,
+        step_timeout_s=0,
+    )
+    cap = _CaptureSink()
+    obs = Observer(sinks=[cap])
+    ck = _FakeCheckpointer()
+
+    def step_fn(state, batch):
+        if step_sleep:
+            _time.sleep(step_sleep)
+        i = state["i"] + 1
+        bad = i in nonfinite_steps
+        return dict(state, i=i), {
+            "loss": float("nan") if bad else 2.0 + i * 0.01,
+            "gnorm": float("nan") if bad else 1.0,
+            "lr": 0.1,
+            "nonfinite": 1.0 if bad else 0.0,
+        }
+
+    loss = _train_loop(
+        cfg,
+        {"i": start_step},
+        step_fn,
+        0,
+        iter(int, 1),  # infinite stream of dummy batches
+        None,
+        ck,
+        start_step,
+        0,
+        obs,
+        1,
+    )
+    return loss, cap.records, ck
+
+
+def test_train_loop_partial_window_rates_use_true_step_count():
+    """A resume's first report window is partial (len(fetched) <
+    report_interval): the record's step_time_s / throughput must divide
+    by the TRUE step count, not the configured interval — else a resume
+    inflates the persistent throughput/MFU record 2x here."""
+    per_step = 0.05
+    loss, records, _ = _drive_loop(
+        num_steps=4, report_interval=4, start_step=2, step_sleep=per_step
+    )
+    assert [r["step"] for r in records] == [4]
+    rec = records[0]
+    # two steps of >= 50ms each: a report_interval divisor would halve it
+    assert rec["step_time_s"] >= per_step * 0.9, rec["step_time_s"]
+    # rate and step time stay algebraically consistent with batch tokens
+    assert rec["tokens_per_sec_per_chip"] * rec["step_time_s"] == pytest.approx(
+        2 * 8
+    )
+
+
+def test_train_loop_drains_tail_window_on_exit():
+    """num_steps lands mid-report-window: the tail steps' non-finite
+    flags must still reach the guard (skipped_steps in the final record)
+    and the final save's metadata — not vanish with the undrained
+    window."""
+    loss, records, ck = _drive_loop(
+        num_steps=6, report_interval=4, nonfinite_steps={6}
+    )
+    assert [r["step"] for r in records] == [4, 6]
+    tail = records[-1]
+    assert tail["skipped_steps_window"] == 1
+    assert tail["skipped_steps"] == 1
+    # the drained window still carries its clean step's loss
+    assert tail["loss"] == pytest.approx(2.0 + 5 * 0.01)
+    # the final save's metadata records the guard's totals
+    steps = [s for s in ck.saves if s[1] == "final"]
+    assert steps and steps[-1][2]["skipped_steps"] == 1
+    # exact tokens at the save step, not the last report's stale figure
+    assert steps[-1][2]["tokens_seen"] == 6 * 2 * 8
+
+
+def test_train_loop_poisoned_window_carries_last_clean_loss(capsys):
+    """Every step of a window non-finite: the window is reported as
+    poisoned — the record's loss is null (never NaN into sinks), the
+    print stream carries the last clean loss, and the returned loss is
+    the carried one."""
+    loss, records, _ = _drive_loop(
+        num_steps=4, report_interval=2, nonfinite_steps={3, 4}
+    )
+    out = capsys.readouterr().out
+    assert "report window poisoned: all 2 step(s) non-finite" in out
+    clean, poisoned = records
+    assert clean["loss"] is not None
+    assert poisoned["loss"] is None
+    assert poisoned["grad_norm"] is None
+    assert poisoned["skipped_steps_window"] == 2
+    assert poisoned["extra"].get("window_poisoned") == 1
+    # carried: the last clean window's mean, also the returned loss
+    assert loss == pytest.approx(clean["loss"])
 
 
 # ---- e2e CPU smoke ---------------------------------------------------------
