@@ -35,6 +35,16 @@ BASELINE_MFU = 0.68  # reference Llama2-7B MFU on A100 (BASELINE.md)
 
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
 ROW_TIMEOUT_S = float(os.environ.get("BENCH_ROW_TIMEOUT_S", "900"))
+# The degraded-but-MEASURED tier: when the TPU probe hangs or the
+# backend is not a TPU, bench still measures a relative quant sweep
+# (bf16 vs int8 vs fp8 GEMM step time) at small shapes on whatever
+# backend answers — so the perf trajectory records a real number every
+# round instead of going dark (BENCH_r03–r05 all lost their signal to a
+# 240s probe timeout). BENCH_FALLBACK=0 restores the bare degraded
+# record.
+FALLBACK_ROW_TIMEOUT_S = float(
+    os.environ.get("BENCH_FALLBACK_ROW_TIMEOUT_S", "600")
+)
 
 
 def run_config(
@@ -200,6 +210,19 @@ ROWS = [
             model_overrides={"nlayers": 3},
         ),
     ),
+    # fp8 sibling of the headline: e4m3 forward + e5m2-x-e4m3 dx
+    # (ops/quant.py "fp8_dgrad") — the v5p/v6e fp8 MXU path measured
+    # against the same shapes as the int8 headline and its bf16 twin
+    (
+        "llama2_7b-shaped (L=3) bs=2 selAC=1/4 fp8 seq=4096",
+        dict(
+            variant="llama2_7b",
+            batch_size=2,
+            sel_ac=0.25,
+            quant="fp8_dgrad",
+            model_overrides={"nlayers": 3},
+        ),
+    ),
     (
         "llama3_194m_4k bs=4 selAC=1/2 bf16 seq=4096",
         dict(variant="llama3_194m_4k", batch_size=4, sel_ac=0.5),
@@ -313,20 +336,28 @@ ROWS = [
 ]
 
 
-def _bf16_sibling_label():
-    """The headline's bf16 sibling, located structurally — the row whose
-    run_config kwargs are identical to row 0's minus the int8 quant —
-    so reordering or inserting ROWS entries can't silently mislabel
-    ``bf16_mfu`` with some other row's number. None if absent (the JSON
-    then carries bf16_mfu: null instead of a wrong value)."""
+def _sibling_label(quants):
+    """The headline row's sibling whose run_config kwargs are identical
+    to row 0's minus the quant mode, located structurally — so
+    reordering or inserting ROWS entries can't silently mislabel
+    ``bf16_mfu``/``fp8_mfu`` with some other row's number. None if
+    absent (the JSON then carries null instead of a wrong value)."""
     head_kw = {k: v for k, v in ROWS[0][1].items() if k != "quant"}
     for label, kw in ROWS[1:]:
         if (
-            kw.get("quant", "none") == "none"
+            kw.get("quant", "none") in quants
             and {k: v for k, v in kw.items() if k != "quant"} == head_kw
         ):
             return label
     return None
+
+
+def _bf16_sibling_label():
+    return _sibling_label(("none",))
+
+
+def _fp8_sibling_label():
+    return _sibling_label(("fp8", "fp8_dgrad"))
 
 
 def _child_row(idx):
@@ -382,22 +413,27 @@ def _child_probe():
     print("IMPORT_OK", flush=True)
     if os.environ.get("BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    print("PLATFORM:" + jax.default_backend(), flush=True)
     print("NCHIPS:" + str(len(jax.devices())))
 
 
 def _probe_backend():
-    """Check the accelerator backend in a subprocess. Returns (n_chips, err)."""
+    """Check the accelerator backend in a subprocess.
+    Returns (n_chips, platform, err)."""
     rc, out = _run_subprocess(
         [sys.executable, os.path.abspath(__file__), "--probe"],
         PROBE_TIMEOUT_S,
     )
     if rc is None:
-        return 0, f"backend probe failed: {out}"
+        return 0, None, f"backend probe failed: {out}"
+    platform = None
     for line in (out or "").splitlines():
+        if line.startswith("PLATFORM:"):
+            platform = line.split(":", 1)[1].strip()
         if line.startswith("NCHIPS:"):
-            return int(line.split(":", 1)[1]), None
+            return int(line.split(":", 1)[1]), platform, None
     tail = (out or "").strip().splitlines()[-3:]
-    return 0, f"backend probe rc={rc}: {' | '.join(tail)}"[:400]
+    return 0, platform, f"backend probe rc={rc}: {' | '.join(tail)}"[:400]
 
 
 def _degraded_result(chip, err):
@@ -419,10 +455,139 @@ def _degraded_result(chip, err):
     }
 
 
+def _fallback_quants():
+    return [
+        q.strip()
+        for q in os.environ.get(
+            "BENCH_FALLBACK_QUANTS", "none,int8,fp8"
+        ).split(",")
+        if q.strip()
+    ]
+
+
+def _child_fallback_row(quant):
+    """Run one fallback-tier row in this process (child mode): the tiny
+    llama-shaped quant sweep on the CPU/interpret backend. Small shapes
+    on purpose — the tier measures the RELATIVE cost of the quantized
+    GEMM paths, never an absolute-MFU claim."""
+    os.environ["BENCH_FORCE_CPU"] = "1"  # before run_config imports jax
+    seq = int(os.environ.get("BENCH_FALLBACK_SEQ", "512"))
+    try:
+        r = run_config(
+            "llama3_194m_4k",
+            batch_size=1,
+            sel_ac=0,
+            quant=quant,
+            seq_length=seq,
+            steps=int(os.environ.get("BENCH_FALLBACK_STEPS", "6")),
+            reps=2,
+            model_overrides={
+                "nlayers": 2,
+                "emb_dim": 256,
+                "nheads": 4,
+                "kvheads": 2,
+                "src_vocab_size": 2048,
+            },
+        )
+    except Exception as e:  # noqa: BLE001
+        r = {"error": f"{type(e).__name__}: {e}"[:300]}
+    r["config"] = f"fallback llama-shaped tiny (L=2, d=256) {quant} seq={seq}"
+    r["quant"] = quant
+    r["fallback"] = True
+    print("BENCH_ROW_JSON:" + json.dumps(r))
+
+
+def _fallback_tier(chip, backend, probe_err):
+    """Degraded-but-MEASURED record: the TPU headline is unavailable
+    (probe hang, or a non-TPU backend), so measure the quant sweep at
+    small shapes on the answering backend and report the bf16-vs-int8-
+    vs-fp8 step-time ratios. A real relative number lands in the
+    trajectory every round; only a failure of THIS tier too yields the
+    bare degraded record."""
+    rows = []
+    for quant in _fallback_quants():
+        label = f"fallback {quant}"
+        rc, out = _run_subprocess(
+            [
+                sys.executable,
+                os.path.abspath(__file__),
+                "--fallback-row",
+                quant,
+            ],
+            FALLBACK_ROW_TIMEOUT_S,
+        )
+        r = None
+        if rc is not None:
+            for line in (out or "").splitlines():
+                if line.startswith("BENCH_ROW_JSON:"):
+                    try:
+                        r = json.loads(line[len("BENCH_ROW_JSON:") :])
+                    except json.JSONDecodeError:
+                        r = None
+        if r is None:
+            err = out if rc is None else (
+                f"fallback row rc={rc}: "
+                + " | ".join((out or "").strip().splitlines()[-3:])
+            )
+            r = {"error": str(err)[:400], "config": label, "quant": quant}
+        rows.append(r)
+
+    by_quant = {
+        r["quant"]: r
+        for r in rows
+        if "error" not in r and r.get("step_time_s")
+    }
+    bf16 = by_quant.get("none")
+    rel = {
+        q: round(bf16["step_time_s"] / r["step_time_s"], 4)
+        for q, r in by_quant.items()
+        if bf16 and q != "none"
+    }
+    if not bf16 or not rel:
+        res = _degraded_result(chip, probe_err)
+        # _child_fallback_row pins the CPU backend regardless of what
+        # the probe saw — the label must state where the measurement
+        # (attempt) ran, never the probe's platform
+        res["fallback_backend"] = "cpu"
+        res["probe_platform"] = backend
+        res["fallback_error"] = (
+            "; ".join(
+                str(r.get("error", "no measurement"))[:120] for r in rows
+            )
+            or "no fallback rows ran"
+        )
+        res["rows"] = rows
+        return res
+    # headline: the int8 ratio when measured, else the first mode's
+    value = rel.get("int8", next(iter(rel.values())))
+    return {
+        "metric": (
+            "quant GEMM relative step time vs bf16 (FALLBACK tier: "
+            "cpu backend, small shapes — TPU probe unavailable; "
+            ">1.0 = quantized mode faster)"
+        ),
+        "value": value,
+        "unit": "x_bf16_step_time",
+        # the A100-MFU baseline is incomparable with a small-shape CPU
+        # ratio; the measured relatives ride in quant_relative + rows
+        "vs_baseline": None,
+        "degraded": False,
+        # the rows were measured on the forced-CPU child backend; the
+        # platform the probe answered with rides separately
+        "fallback_backend": "cpu",
+        "probe_platform": backend,
+        "probe_error": probe_err,
+        "quant_relative": rel,
+        "bf16_step_time_s": bf16["step_time_s"],
+        "rows": rows,
+    }
+
+
 def _finish(result):
     """Print the contract line; under BENCH_STRICT=1 (CI) a degraded
     record also exits nonzero so an unmeasured run can never pass as a
-    clean data point."""
+    clean data point. A measured fallback-tier record is NOT degraded —
+    it carries fallback_backend + real rows."""
     print(json.dumps(result))
     if result.get("degraded") and os.environ.get("BENCH_STRICT"):
         sys.exit(3)
@@ -430,11 +595,30 @@ def _finish(result):
 
 def main():
     chip = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    n_chips, probe_err = _probe_backend()
+    n_chips, platform, probe_err = _probe_backend()
+
+    # a healthy probe on a non-TPU backend: the full-shape TPU rows
+    # would be meaningless (or take hours on CPU) — route to the
+    # measured fallback tier instead. BENCH_SMOKE / BENCH_FORCE_CPU are
+    # explicit operator requests to run the real rows on CPU anyway.
+    if (
+        probe_err is None
+        and platform != "tpu"
+        and not os.environ.get("BENCH_SMOKE")
+        and not os.environ.get("BENCH_FORCE_CPU")
+    ):
+        probe_err = (
+            f"backend is {platform!r}, not tpu — full-shape headline "
+            "rows are not comparable"
+        )
 
     if probe_err is not None:
-        # Backend unavailable: still emit the contract JSON line.
-        _finish(_degraded_result(chip, probe_err))
+        # Backend unavailable (or not a TPU): still emit the contract
+        # JSON line — measured via the fallback tier when possible.
+        if os.environ.get("BENCH_FALLBACK", "1") != "0":
+            _finish(_fallback_tier(chip, platform, probe_err))
+        else:
+            _finish(_degraded_result(chip, probe_err))
         return
 
     # BENCH_ROWS="0,1" restricts the sweep to a row subset (the smoke
@@ -491,6 +675,14 @@ def main():
         if bf16_label is not None
         else None
     )
+    # the fp8 sibling rides alongside for the same reason: the
+    # bf16-vs-int8-vs-fp8 trio in one object is the mode-matrix readout
+    fp8_label = _fp8_sibling_label()
+    fp8 = (
+        next((r for r in rows if r.get("config") == fp8_label), None)
+        if fp8_label is not None
+        else None
+    )
     head_mfu = head.get("mfu")
     result = {
         "metric": f"Llama2-7B-shaped train MFU (int8 fwd+dgrad GEMMs, {n_chips}x {chip} chip)",
@@ -514,6 +706,12 @@ def main():
             if bf16 and "mfu" in bf16
             else None
         ),
+        "fp8_mfu": (fp8 or {}).get("mfu"),
+        "fp8_vs_baseline": (
+            round(fp8["mfu"] / BASELINE_MFU, 4)
+            if fp8 and "mfu" in fp8
+            else None
+        ),
         "hfu": head.get("hfu"),
         "tokens_per_sec_per_chip": head.get("tokens_per_sec_per_chip"),
         "step_time_s": head.get("step_time_s"),
@@ -533,6 +731,8 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--row":
         _child_row(int(sys.argv[2]))
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--fallback-row":
+        _child_fallback_row(sys.argv[2])
     elif len(sys.argv) >= 2 and sys.argv[1] == "--probe":
         _child_probe()
     else:

@@ -76,9 +76,21 @@ class TrainConfig:
     loss_chunk_size: int = 4096  # tokens per fused-loss logits tile
     # "none" | "int8" (fwd GEMMs on the MXU int8 path, ~2x bf16 rate on
     # v5e+, bf16 backward) | "int8_dgrad" (additionally int8 dx; wgrad
-    # stays bf16) — see ops/quant.py. TPU-native win with no reference
-    # counterpart.
+    # stays bf16) | "fp8" / "fp8_dgrad" (e4m3 forward, optionally
+    # e5m2-gradient dx; v5p/v6e fp8 MXU path) — see ops/quant.py.
+    # TPU-native win with no reference counterpart.
     quantized_matmuls: str = "none"
+    # Gradient-reduction wire format (docs/performance.md "Quantized
+    # training"): "none" (bit-identical to the unquantized step) |
+    # "int8" / "fp8" (scale-carrying reduce, dynamic per-row scales) |
+    # "fp8_delayed" (per-leaf scales from an amax history threaded
+    # through the train state — checkpoints and elastic-reshards like
+    # optimizer state). FSDP throughput is bandwidth-bound, so the
+    # reduce bytes are the lever (PAPERS.md "Memory and Bandwidth ...").
+    quantized_reduce: str = "none"
+    # amax-history window for quantized_reduce="fp8_delayed" (the
+    # TransformerEngine-style delayed-scaling recipe)
+    fp8_amax_history_len: int = 16
     # Kernel autotuning (docs/performance.md "Autotuning"): "auto" reads
     # tile/block/chunk choices for flash, SSD, and fused-CE from the
     # committed per-chip tuning table (KERNEL_TUNING.json), falling back

@@ -43,12 +43,18 @@ class Observer:
         clock: Callable[[], float] = time.monotonic,
         strict_schema: bool = False,
         kernel_tuning: Optional[str] = None,
+        quantized_matmuls: Optional[str] = None,
+        quantized_reduce: Optional[str] = None,
     ):
         self.registry = MetricRegistry()
         # the kernel-tuning mode this run's step was built under (v3
         # schema field); resolved tiles arrive via the registry
         # (tune.lookup.attach_registry) as kernel.tune.* extras
         self.kernel_tuning = kernel_tuning
+        # the quantization modes the step was built under (v4 fields):
+        # a perf record must state the numerics that produced it
+        self.quantized_matmuls = quantized_matmuls
+        self.quantized_reduce = quantized_reduce
         self.timer = PhaseTimer(clock=clock)
         self.goodput = GoodputTracker()
         self.sinks = sinks or []
@@ -169,6 +175,8 @@ class Observer:
             "skipped_steps": int(skipped_steps_total),
             "skipped_steps_window": int(skipped_steps_window),
             "kernel_tuning": self.kernel_tuning,
+            "quantized_matmuls": self.quantized_matmuls,
+            "quantized_reduce": self.quantized_reduce,
             "memory_reserved_bytes": (
                 None
                 if memory_reserved_bytes is None
@@ -276,6 +284,8 @@ def build_observer(
         clock=clock,
         strict_schema=bool(getattr(cfg, "obs_strict_schema", False)),
         kernel_tuning=getattr(cfg, "kernel_tuning", None),
+        quantized_matmuls=getattr(cfg, "quantized_matmuls", None),
+        quantized_reduce=getattr(cfg, "quantized_reduce", None),
     )
     # resolved kernel tiles (kernel.tune.* gauges) land in this
     # observer's registry from the trace-time lookup — attach before the

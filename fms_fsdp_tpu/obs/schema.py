@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -65,6 +65,15 @@ SCHEMA_FIELDS = {
     # values; "pinned" = the call site or a non-default config value
     # named the tile explicitly while tuning was on).
     "kernel_tuning": ("str", False),
+    # v4: the quantization modes the run's step was built under — the
+    # GEMM path ("none" | "int8" | "int8_dgrad" | "fp8" | "fp8_dgrad",
+    # ops/quant.py) and the gradient-reduction wire format ("none" |
+    # "int8" | "fp8" | "fp8_delayed", parallel/sharding.py). A perf
+    # record must state the numerics that produced it; the tuner's
+    # resolved flash quant family additionally rides in ``extra`` as
+    # kernel.tune.flash.quant_code (0=none 1=int8 2=fp8).
+    "quantized_matmuls": ("str", False),
+    "quantized_reduce": ("str", False),
     "memory_reserved_bytes": ("int", False),
     "memory_allocated_bytes": ("int", False),
     "extra": ("map", False),
@@ -81,6 +90,10 @@ SCHEMA_DIGESTS = {
     # v3: + kernel_tuning (autotuner mode; resolved tiles ride in extra
     # as kernel.tune.* gauges)
     3: "f040074f56e65a7aef0e33bb7281fd38b6f1941115ee5e862412962b5f5c2a84",
+    # v4: + quantized_matmuls / quantized_reduce (the step's GEMM and
+    # gradient-reduce quantization modes; the tuner's flash quant family
+    # rides in extra as kernel.tune.flash.quant_code)
+    4: "488f2ccf06394fbc05445c7134628520fef64de1cd61a1bd6bf44000bd1ee66e",
 }
 
 

@@ -874,6 +874,32 @@ def _use_kvgrid(seq_k: int, variant=None) -> bool:
     return seq_k > MAX_KERNEL_SEQ
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _wire_ste(x, wire: str):
+    """Round-trip one attention operand through the quantized-family
+    wire format (per-row absmax along the head dim; int8 grid or e4m3
+    fp8 — operands want mantissa, unlike the e5m2 gradient wire) with
+    straight-through gradients: the round-trip is piecewise constant,
+    so its true jacobian is 0 a.e. — the identity cotangent is the
+    standard QAT estimator and keeps the backward exactly the
+    unquantized kernel's."""
+    from fms_fsdp_tpu.ops.quant import activation_roundtrip
+
+    return activation_roundtrip(x, wire)
+
+
+def _wire_ste_fwd(x, wire):
+    return _wire_ste(x, wire), None
+
+
+def _wire_ste_bwd(wire, res, g):
+    del wire, res
+    return (g,)
+
+
+_wire_ste.defvjp(_wire_ste_fwd, _wire_ste_bwd)
+
+
 def supports(q_shape, k_shape) -> bool:
     """Eligibility of the Pallas path for these shapes."""
     _, sq, nq, h = q_shape
@@ -904,6 +930,7 @@ def flash_attention(
     interpret: bool = False,
     return_lse: bool = False,
     variant=None,
+    quant=None,
 ):
     """q: (B, S, Nq, H); k/v: (B, S, Nkv, H) -> (B, S, Nq, H).
 
@@ -914,6 +941,13 @@ def flash_attention(
     or the table has no legal entry. Passing them explicitly pins the
     values (tests, ring attention's bwd partials). The resolution is
     pure host table/cost-model work at trace time — never a sweep.
+
+    A table entry carrying ``quant`` ("int8"/"fp8") — or the explicit
+    ``quant=`` arg (the autotune sweep pinning a candidate) — selects
+    the quantized kernel family: q/k are round-tripped through the wire
+    format (per-row absmax scales, straight-through gradients) before
+    the score GEMM. The committed table carries no quant entries, so
+    stock runs never take this branch.
 
     With ``return_lse``, also returns the per-query logsumexp
     (B, S, Nq, 1) fp32 as a differentiable output, enabling exact
@@ -927,14 +961,35 @@ def flash_attention(
     scale = float(scale if scale is not None else q.shape[-1] ** -0.5)
     # a per-call variant arg pins the family; else the process-wide
     # forcing (set_kernel_variant) pins it; else the table may pick it
-    bq, bk, fam, _ = resolve_flash(
+    bq, bk, fam, qnt, _ = resolve_flash(
         q.shape,
         k.shape,
         str(q.dtype),
         requested_q=block_q,
         requested_k=block_k,
         requested_variant=variant if variant is not None else _VARIANT,
+        requested_quant=quant,
     )
+    if qnt in ("int8", "fp8"):
+        # quantized family (tuning table or the autotune sweep opted
+        # in): q/k ride the wire format of the score GEMM. Execution
+        # today is simulated quantization — the operands are
+        # round-tripped through the wire dtype (straight-through
+        # gradients) before the unquantized kernel — so the numerics
+        # are exactly the quantized kernel's while the int8/fp8 Mosaic
+        # score path lands; the tuner's VMEM model (tune/candidates.py)
+        # prices the 1-byte kv residency so committed tables stay
+        # forward-compatible.
+        q = _wire_ste(q, qnt)
+        k = _wire_ste(k, qnt)
+        if fam == "resident" and k.shape[1] > MAX_KERNEL_SEQ:
+            # the cost model legalizes resident past the bf16 cap on
+            # the strength of the 1-byte kv stream, but the SIMULATED
+            # execution still runs the full-width bf16 kernel — let the
+            # sequence rule pick the executable family until the real
+            # quantized kernel lands (record_final_flash_blocks states
+            # what actually ran)
+            fam = None
     block_q = _pick_block(q.shape[1], bq, kind="q")
     block_k = _pick_block(k.shape[1], bk, kind="k")
     # the record must state what actually runs: the post-halving tiles

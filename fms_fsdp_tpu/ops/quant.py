@@ -1,28 +1,55 @@
-"""int8 quantized matmuls for the forward pass (v5e/v5p MXU int8 path).
+"""Quantized matmuls (int8 + fp8) and the gradient wire formats.
 
 The reference trains pure-bf16 GEMMs (ref:policies/mixed_precision.py) —
 on A100 that is the right call. TPU v5e's MXU runs int8 at ~2x its bf16
-rate (394 vs 197 peak TOPS; ~254 vs ~150 sustained on 8k matmuls here),
-so this module implements the standard dynamic-quantization recipe (AQT
-style) to buy that factor for the forward pass:
+rate (394 vs 197 peak TOPS; ~254 vs ~150 sustained on 8k matmuls here)
+and fp8 at the same 2x class rate on v5p/v6e, so this module implements
+the standard dynamic-quantization recipes to buy that factor:
 
-- activations: per-row (per-token) absmax scale to int8;
-- weights: per-column (per-output-channel) absmax scale to int8;
-- int8 x int8 -> int32 accumulation on the MXU, dequantized by the outer
-  product of the two scale vectors (rank-1 — exact, cheap, fuses);
+- activations: per-row (per-token) absmax scale to int8/fp8;
+- weights: per-column (per-output-channel) absmax scale to int8/fp8;
+- int8 x int8 -> int32 (or fp8 x fp8 -> fp32) accumulation on the MXU,
+  dequantized by the outer product of the two scale vectors (rank-1 —
+  exact, cheap, fuses);
 - backward: straight-through to the bf16 operands (dx = g @ W^T,
-  dW = x^T @ g computed in bf16), so gradients are exactly those of the
-  unquantized matmul evaluated at the same operands.
+  dW = x^T @ g computed in bf16 with fp32 accumulation), so gradients
+  are exactly those of the unquantized matmul evaluated at the same
+  operands;
+- "_dgrad" modes additionally run dx on the quantized path (fp8 dx uses
+  e5m2 for the incoming gradient — gradients need e5m2's exponent
+  range, not e4m3's mantissa — against e4m3 weights, the standard
+  TransformerEngine pairing). wgrad ALWAYS stays unquantized: it
+  accumulates over every token, and quantization noise there biases the
+  update while dgrad noise washes out like activation noise.
+
+fp8 rounding differs from int8: there is no round-to-127 grid — the
+cast itself rounds to the nearest representable. Out-of-range values
+must be clamped BEFORE the cast (e4m3fn overflows to NaN, e5m2 to inf;
+neither saturates).
 
 The quantization overhead is a few elementwise passes per GEMM — O(T*D +
 D*F + T*F) VPU work against O(T*D*F) MXU work — negligible at training
-shapes. Enabled via ``TrainConfig.quantized_matmuls = "int8"``.
+shapes. Enabled via ``TrainConfig.quantized_matmuls`` ("int8",
+"int8_dgrad", "fp8", "fp8_dgrad").
+
+This module also owns the gradient *wire* formats for the quantized
+cross-device reduction (``TrainConfig.quantized_reduce``): a
+scale-carrying round-trip of each gradient leaf through int8/fp8 with
+per-row scales (dynamic) or a per-leaf delayed scale from an amax
+history (``fp8_delayed``). The tree-level orchestration lives in
+parallel/sharding.py::quantized_grad_reduce.
 """
 
 import functools
 
 import jax
 import jax.numpy as jnp
+
+FP8_E4M3 = jnp.float8_e4m3fn
+FP8_E5M2 = jnp.float8_e5m2
+# largest finite magnitudes; the clamp bound before any fp8 cast
+FP8_E4M3_MAX = 448.0
+FP8_E5M2_MAX = 57344.0
 
 
 def _absmax_quant(x, axis):
@@ -40,6 +67,18 @@ def _absmax_quant(x, axis):
     return q, jnp.where(scale == 0, 0.0, scale)
 
 
+def _absmax_quant_fp8(x, axis, dtype):
+    """Symmetric fp8 quantization along ``axis``: scale maps the absmax
+    to the format's largest finite value; the clamp before the cast is
+    load-bearing (e4m3fn overflows to NaN, e5m2 to inf)."""
+    fmax = FP8_E4M3_MAX if dtype == FP8_E4M3 else FP8_E5M2_MAX
+    amax = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
+    scale = (amax / fmax).astype(jnp.float32)
+    safe = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(x.astype(jnp.float32) / safe, -fmax, fmax).astype(dtype)
+    return q, jnp.where(scale == 0, 0.0, scale)
+
+
 def int8_matmul_raw(x, w):
     """x (..., T, D) @ w (D, F) via int8 MXU with dynamic dequant."""
     qx, sx = _absmax_quant(x, axis=-1)  # sx (..., T, 1)
@@ -53,38 +92,68 @@ def int8_matmul_raw(x, w):
     return (acc.astype(jnp.float32) * sx * sw).astype(x.dtype)
 
 
-def _dgrad(g, w, quantized: bool):
-    """dx = g @ w^T, optionally on the int8 path (per-row g scale,
-    per-row w scale — both contract over the F dim)."""
-    if not quantized:
-        return jax.lax.dot_general(g, w, (((g.ndim - 1,), (1,)), ((), ())))
-    qg, sg = _absmax_quant(g, axis=-1)  # (..., T, 1)
-    qw, sw = _absmax_quant(w, axis=1)  # (D, 1)
+def fp8_matmul_raw(x, w):
+    """x (..., T, D) @ w (D, F) via fp8 (e4m3 x e4m3 -> fp32) with the
+    same per-row / per-column dynamic dequant as the int8 path."""
+    qx, sx = _absmax_quant_fp8(x, axis=-1, dtype=FP8_E4M3)  # sx (..., T, 1)
+    qw, sw = _absmax_quant_fp8(w, axis=0, dtype=FP8_E4M3)  # sw (1, F)
     acc = jax.lax.dot_general(
-        qg, qw, (((g.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.int32
+        qx,
+        qw,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
+    return (acc * sx * sw).astype(x.dtype)
+
+
+def _dgrad(g, w, wire):
+    """dx = g @ w^T, optionally on a quantized path (per-row g scale,
+    per-row w scale — both contract over the F dim). ``wire`` is None
+    (exact), "int8", or "fp8" (e5m2 gradient x e4m3 weight)."""
+    dims = (((g.ndim - 1,), (1,)), ((), ()))
+    if wire is None:
+        return jax.lax.dot_general(g, w, dims)
+    if wire == "int8":
+        qg, sg = _absmax_quant(g, axis=-1)  # (..., T, 1)
+        qw, sw = _absmax_quant(w, axis=1)  # (D, 1)
+        acc = jax.lax.dot_general(
+            qg, qw, dims, preferred_element_type=jnp.int32
+        )
+    else:
+        qg, sg = _absmax_quant_fp8(g, axis=-1, dtype=FP8_E5M2)
+        qw, sw = _absmax_quant_fp8(w, axis=1, dtype=FP8_E4M3)
+        acc = jax.lax.dot_general(
+            qg, qw, dims, preferred_element_type=jnp.float32
+        )
     return acc.astype(jnp.float32) * sg * jnp.squeeze(sw, -1)
 
 
 def _wgrad(x, g):
-    # dW contracts over all leading (token) dims of x/g. Stays bf16: the
-    # weight-gradient accumulates over every token — int8 noise there
-    # biases the update, while dgrad noise washes out like activation noise.
+    # dW contracts over all leading (token) dims of x/g. Stays
+    # unquantized: the weight-gradient accumulates over every token —
+    # int8/fp8 noise there biases the update, while dgrad noise washes
+    # out like activation noise. The accumulation is pinned to fp32
+    # (preferred_element_type) so the optimizer-bound dW is never a
+    # bf16-accumulated sum even when the operands are bf16; the caller
+    # casts the fp32 result to the cotangent dtype, which for an fp32
+    # param policy is a no-op (bit-identical to the unquantized dW).
     lead = tuple(range(g.ndim - 1))
-    return jax.lax.dot_general(x, g, ((lead, lead), ((), ())))
+    return jax.lax.dot_general(
+        x, g, ((lead, lead), ((), ())), preferred_element_type=jnp.float32
+    )
 
 
-def _make_int8_matmul(dgrad_int8: bool):
+def _make_quant_matmul(raw_fn, dgrad_wire):
     @jax.custom_vjp
     def f(x, w):
-        return int8_matmul_raw(x, w)
+        return raw_fn(x, w)
 
     def fwd(x, w):
-        return int8_matmul_raw(x, w), (x, w)
+        return raw_fn(x, w), (x, w)
 
     def bwd(res, g):
         x, w = res
-        dx = _dgrad(g, w, dgrad_int8)
+        dx = _dgrad(g, w, dgrad_wire)
         dw = _wgrad(x, g)
         return dx.astype(x.dtype), dw.astype(w.dtype)
 
@@ -92,8 +161,10 @@ def _make_int8_matmul(dgrad_int8: bool):
     return f
 
 
-int8_matmul = _make_int8_matmul(dgrad_int8=False)
-int8_matmul_dgrad = _make_int8_matmul(dgrad_int8=True)
+int8_matmul = _make_quant_matmul(int8_matmul_raw, dgrad_wire=None)
+int8_matmul_dgrad = _make_quant_matmul(int8_matmul_raw, dgrad_wire="int8")
+fp8_matmul = _make_quant_matmul(fp8_matmul_raw, dgrad_wire=None)
+fp8_matmul_dgrad = _make_quant_matmul(fp8_matmul_raw, dgrad_wire="fp8")
 
 
 def matmul(x, w, *, quant: str = "none"):
@@ -102,11 +173,17 @@ def matmul(x, w, *, quant: str = "none"):
     - "none":       bf16 GEMMs (reference behavior)
     - "int8":       int8 forward, bf16 backward
     - "int8_dgrad": int8 forward + int8 dx (wgrad stays bf16)
+    - "fp8":        e4m3 forward, bf16 backward
+    - "fp8_dgrad":  e4m3 forward + e5m2-x-e4m3 dx (wgrad stays bf16)
     """
     if quant == "int8":
         return int8_matmul(x, w)
     if quant == "int8_dgrad":
         return int8_matmul_dgrad(x, w)
+    if quant == "fp8":
+        return fp8_matmul(x, w)
+    if quant == "fp8_dgrad":
+        return fp8_matmul_dgrad(x, w)
     if quant != "none":
         raise ValueError(f"unknown quantized_matmuls value: {quant!r}")
     return x @ w
@@ -129,34 +206,61 @@ def int8_expert_matmul_raw(x, w):
     return (acc.astype(jnp.float32) * sx * sw[:, None]).astype(x.dtype)
 
 
-def _expert_dgrad(g, w, quantized: bool):
-    """dx = g @ w^T per expert: g (E, B, C, F), w (E, K, F) -> (E, B, C, K)."""
+def fp8_expert_matmul_raw(x, w):
+    """fp8 (e4m3) variant of ``int8_expert_matmul_raw``: same E-major
+    layout argument, fp32 MXU accumulation in place of int32."""
+    qx, sx = _absmax_quant_fp8(x, axis=-1, dtype=FP8_E4M3)  # (E, B, C, 1)
+    qw, sw = _absmax_quant_fp8(w, axis=1, dtype=FP8_E4M3)  # (E, 1, F)
+    acc = jax.lax.dot_general(
+        qx,
+        qw,
+        (((3,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # (E, B, C, F)
+    return (acc * sx * sw[:, None]).astype(x.dtype)
+
+
+def _expert_dgrad(g, w, wire):
+    """dx = g @ w^T per expert: g (E, B, C, F), w (E, K, F) -> (E, B, C, K).
+    ``wire`` is None (exact), "int8", or "fp8" (e5m2 x e4m3)."""
     dims = (((3,), (2,)), ((0,), (0,)))
-    if not quantized:
+    if wire is None:
         return jax.lax.dot_general(g, w, dims)
-    qg, sg = _absmax_quant(g, axis=-1)  # (E, B, C, 1)
-    qw, sw = _absmax_quant(w, axis=2)  # (E, K, 1)
-    acc = jax.lax.dot_general(qg, qw, dims, preferred_element_type=jnp.int32)
+    if wire == "int8":
+        qg, sg = _absmax_quant(g, axis=-1)  # (E, B, C, 1)
+        qw, sw = _absmax_quant(w, axis=2)  # (E, K, 1)
+        acc = jax.lax.dot_general(
+            qg, qw, dims, preferred_element_type=jnp.int32
+        )
+    else:
+        qg, sg = _absmax_quant_fp8(g, axis=-1, dtype=FP8_E5M2)
+        qw, sw = _absmax_quant_fp8(w, axis=2, dtype=FP8_E4M3)
+        acc = jax.lax.dot_general(
+            qg, qw, dims, preferred_element_type=jnp.float32
+        )
     return acc.astype(jnp.float32) * sg * jnp.squeeze(sw, -1)[:, None, None, :]
 
 
 def _expert_wgrad(x, g):
-    # dW (E, K, F) contracts the token dims (B, C); bf16 for the same
-    # bias-accumulation reason as _wgrad.
-    return jax.lax.dot_general(x, g, (((1, 2), (1, 2)), ((0,), (0,))))
+    # dW (E, K, F) contracts the token dims (B, C); unquantized with the
+    # accumulation pinned fp32, for the same reasons as _wgrad.
+    return jax.lax.dot_general(
+        x, g, (((1, 2), (1, 2)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )
 
 
-def _make_int8_expert_matmul(dgrad_int8: bool):
+def _make_quant_expert_matmul(raw_fn, dgrad_wire):
     @jax.custom_vjp
     def f(x, w):
-        return int8_expert_matmul_raw(x, w)
+        return raw_fn(x, w)
 
     def fwd(x, w):
-        return int8_expert_matmul_raw(x, w), (x, w)
+        return raw_fn(x, w), (x, w)
 
     def bwd(res, g):
         x, w = res
-        dx = _expert_dgrad(g, w, dgrad_int8)
+        dx = _expert_dgrad(g, w, dgrad_wire)
         dw = _expert_wgrad(x, g)
         return dx.astype(x.dtype), dw.astype(w.dtype)
 
@@ -164,8 +268,18 @@ def _make_int8_expert_matmul(dgrad_int8: bool):
     return f
 
 
-int8_expert_matmul = _make_int8_expert_matmul(dgrad_int8=False)
-int8_expert_matmul_dgrad = _make_int8_expert_matmul(dgrad_int8=True)
+int8_expert_matmul = _make_quant_expert_matmul(
+    int8_expert_matmul_raw, dgrad_wire=None
+)
+int8_expert_matmul_dgrad = _make_quant_expert_matmul(
+    int8_expert_matmul_raw, dgrad_wire="int8"
+)
+fp8_expert_matmul = _make_quant_expert_matmul(
+    fp8_expert_matmul_raw, dgrad_wire=None
+)
+fp8_expert_matmul_dgrad = _make_quant_expert_matmul(
+    fp8_expert_matmul_raw, dgrad_wire="fp8"
+)
 
 
 def expert_matmul(x, w, *, quant: str = "none"):
@@ -176,6 +290,100 @@ def expert_matmul(x, w, *, quant: str = "none"):
         return int8_expert_matmul(x, w)
     if quant == "int8_dgrad":
         return int8_expert_matmul_dgrad(x, w)
+    if quant == "fp8":
+        return fp8_expert_matmul(x, w)
+    if quant == "fp8_dgrad":
+        return fp8_expert_matmul_dgrad(x, w)
     if quant != "none":
         raise ValueError(f"unknown quantized_matmuls value: {quant!r}")
     return jnp.einsum("ebck,ekf->ebcf", x, w)
+
+
+# ---------------------------------------------------------------------------
+# gradient wire formats (quantized cross-device reduction)
+# ---------------------------------------------------------------------------
+# (the legal TrainConfig mode list lives with its validation:
+# parallel/mixed_precision.py::REDUCE_QUANT_MODES)
+
+
+def _row_axis(g):
+    """Scale granularity for the reduce wire: per-row (last axis reduced)
+    for matrices — finer than any per-shard scale, so every legal FSDP
+    shard boundary carries its own scales — and per-tensor for vectors
+    (a per-element scale would make the round-trip lossless, hiding the
+    wire format entirely)."""
+    return -1 if g.ndim >= 2 else None
+
+
+def wire_roundtrip(g, wire: str, scale=None):
+    """Round-trip one gradient leaf through the reduce wire format,
+    returning an array of g's dtype: the wire's resolution applied to
+    this leaf (see parallel/sharding.py::quantized_grad_reduce for the
+    single-draw-vs-per-rank contract). ``scale`` (fp8_delayed) is a per-leaf
+    fp32 scalar from the amax history; None means dynamic per-row absmax
+    scales computed from g itself."""
+    if wire == "int8":
+        axis = _row_axis(g)
+        if axis is None:
+            # vectors: one per-tensor scale via the same shared recipe
+            q, s = _absmax_quant(g.reshape(1, -1), axis=-1)
+            return (q.astype(jnp.float32) * s).reshape(g.shape).astype(g.dtype)
+        q, s = _absmax_quant(g, axis=axis)
+        return (q.astype(jnp.float32) * s).astype(g.dtype)
+    if wire == "fp8":
+        axis = _row_axis(g)
+        if axis is None:
+            q, s = _absmax_quant_fp8(g.reshape(1, -1), axis=-1, dtype=FP8_E5M2)
+            return (q.astype(jnp.float32) * s).reshape(g.shape).astype(g.dtype)
+        q, s = _absmax_quant_fp8(g, axis=axis, dtype=FP8_E5M2)
+        return (q.astype(jnp.float32) * s).astype(g.dtype)
+    if wire == "fp8_delayed":
+        # per-leaf delayed scale: clamp to the representable range (a
+        # growing amax between history updates would otherwise overflow
+        # e5m2 to inf), cast, dequantize
+        safe = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(
+            g.astype(jnp.float32) / safe, -FP8_E5M2_MAX, FP8_E5M2_MAX
+        ).astype(FP8_E5M2)
+        return (
+            q.astype(jnp.float32) * jnp.where(scale == 0, 0.0, scale)
+        ).astype(g.dtype)
+    raise ValueError(f"unknown reduce wire: {wire!r}")
+
+
+def activation_roundtrip(x, wire: str):
+    """Operand wire format for the quantized attention family
+    (ops/flash_attention.py): per-row absmax along the head (last) dim,
+    int8 grid or **e4m3** fp8 — activations want e4m3's mantissa; the
+    e5m2 wire above is for gradients, which need exponent range."""
+    if wire == "int8":
+        q, s = _absmax_quant(x, axis=-1)
+        return (q.astype(jnp.float32) * s).astype(x.dtype)
+    if wire == "fp8":
+        q, s = _absmax_quant_fp8(x, axis=-1, dtype=FP8_E4M3)
+        return (q.astype(jnp.float32) * s).astype(x.dtype)
+    raise ValueError(f"unknown activation wire: {wire!r}")
+
+
+def leaf_amax(g):
+    """Current-step absmax of one gradient leaf (fp32 scalar) — the
+    value appended to the delayed-scaling amax history."""
+    return jnp.max(jnp.abs(g.astype(jnp.float32)))
+
+
+def delayed_scale(history, current_amax):
+    """Delayed-scaling scale factor from an (H,) amax history: the
+    largest amax seen over the window, divided by e5m2's largest finite
+    value. An all-zero history (step 0, or a fresh resume field) falls
+    back to the current step's amax — the standard just-in-time
+    bootstrap, so the first step is dynamic rather than clamped to 0."""
+    hist = jnp.max(history)
+    amax = jnp.where(hist > 0, hist, current_amax)
+    return (amax / FP8_E5M2_MAX).astype(jnp.float32)
+
+
+def roll_amax_history(history, current_amax):
+    """Rolling amax window: newest at index 0."""
+    return jnp.concatenate(
+        [current_amax[None].astype(history.dtype), history[:-1]]
+    )

@@ -22,11 +22,23 @@ forward's bf16 all-gather), matching the reference preset. It is recorded
 here for parity/reporting; the train step additionally casts gradients to
 ``param_dtype`` before the optimizer so Adam math always runs in the
 storage precision.
+
+``reduce_quant`` extends the policy below bf16: *Memory and Bandwidth
+are All You Need for FSDP* (PAPERS.md) argues FSDP throughput is
+bandwidth-bound, which makes the gradient reduce-scatter bytes the
+direct lever — the 1-byte int8/fp8 wire formats halve them against
+bf16 (4x against an fp32 reduce).
+The scale-carrying reduce itself lives in
+parallel/sharding.py::quantized_grad_reduce; "none" is bit-identical to
+today's step (the reduce path is not even traced).
 """
 
 from dataclasses import dataclass
 
 import jax.numpy as jnp
+
+# legal TrainConfig.quantized_reduce / DtypePolicy.reduce_quant values
+REDUCE_QUANT_MODES = ("none", "int8", "fp8", "fp8_delayed")
 
 
 @dataclass(frozen=True)
@@ -34,6 +46,10 @@ class DtypePolicy:
     param_dtype: jnp.dtype = jnp.float32  # storage (and optimizer) dtype
     compute_dtype: jnp.dtype = jnp.bfloat16  # matmul / activation dtype
     reduce_dtype: jnp.dtype = jnp.bfloat16  # gradient cross-device reduction
+    # gradient-reduction wire format below reduce_dtype: "none" (exact),
+    # "int8" / "fp8" (dynamic per-row scales), "fp8_delayed" (per-leaf
+    # scale from the amax history threaded through the train state)
+    reduce_quant: str = "none"
 
 
 bfSixteen = DtypePolicy(
@@ -63,9 +79,21 @@ fp32_policy = DtypePolicy(
 
 def get_dtype_policy(cfg) -> DtypePolicy:
     """Map train config -> policy (ref:train_utils.py:192-214 chooses
-    bfSixteen whenever bf16 is supported; on TPU it always is)."""
+    bfSixteen whenever bf16 is supported; on TPU it always is).
+    ``cfg.quantized_reduce`` rides on whichever preset is selected."""
+    rq = getattr(cfg, "quantized_reduce", "none") or "none"
+    if rq not in REDUCE_QUANT_MODES:
+        raise ValueError(
+            f"quantized_reduce={rq!r}: expected one of {REDUCE_QUANT_MODES}"
+        )
     if not getattr(cfg, "mixed_precision", True):
-        return fp32_policy
-    if getattr(cfg, "pure_bf16", False):
-        return bfSixteen_working
-    return bfSixteen
+        policy = fp32_policy
+    elif getattr(cfg, "pure_bf16", False):
+        policy = bfSixteen_working
+    else:
+        policy = bfSixteen
+    if rq == "none":
+        return policy
+    from dataclasses import replace
+
+    return replace(policy, reduce_quant=rq)

@@ -172,6 +172,84 @@ def infer_state_specs(state_shapes, param_specs, params_subtree: str = "params")
     return jax.tree_util.tree_map_with_path(spec_for, state_shapes)
 
 
+# ---------------------------------------------------------------------------
+# quantized gradient reduction (scale-carrying wire format)
+# ---------------------------------------------------------------------------
+
+
+def quant_leaf_key(path) -> str:
+    """Stable dotted name for one gradient leaf. The flat "g."-prefixed
+    string (not a nested tree) is load-bearing twice: the amax-state
+    keys must NOT suffix-match the param spec paths in
+    ``infer_state_specs`` (a (H,) history row sharded like its (D, F)
+    weight would be nonsense — the prefix guarantees no key, top-level
+    leaves included, ever matches), and flat string keys checkpoint as
+    ordinary pytree dict entries."""
+    return "g." + ".".join(_path_key(e) for e in path)
+
+
+def init_amax_state(params_shapes, history_len: int):
+    """Fresh delayed-scaling state for a param(-shaped) tree: one (H,)
+    fp32 amax-history row per gradient leaf, newest at index 0, all
+    zeros (the first step bootstraps from its own dynamic amax — see
+    ops/quant.py::delayed_scale). Lives in the train state under
+    ``state["quant"]`` so it checkpoints, donates, and elastic-reshards
+    (replicated — unmatched by infer_state_specs) like optimizer state."""
+    import jax.numpy as jnp
+
+    flat = jax.tree_util.tree_flatten_with_path(params_shapes)[0]
+    return {
+        "amax_history": {
+            quant_leaf_key(path): jnp.zeros((history_len,), jnp.float32)
+            for path, _ in flat
+        }
+    }
+
+
+def quantized_grad_reduce(grads, mode: str, quant_state=None):
+    """Scale-carrying quantized gradient reduction: round-trip every
+    gradient leaf through the reduce wire format (int8 / e5m2 fp8 with
+    per-row scales, or a per-leaf delayed scale from the amax history).
+
+    Returns ``(grads, new_quant_state)`` — the round-tripped gradients,
+    and (fp8_delayed only) the rolled amax history.
+
+    Numerics contract (what the loss-parity tests pin): ONE
+    quantization draw on the globally-summed gradient — the tree
+    surfacing from the backward is already reduced under GSPMD, so this
+    models the wire's resolution, not a true per-rank reduce-scatter
+    (which would deliver sum(roundtrip(g_i)): N independent noise draws
+    on the partials, strictly noisier than the single draw here). A
+    future in-collective implementation (custom reduce-scatter over the
+    wire dtype, the actual bandwidth win) must re-pin the parity
+    tolerances against that per-shard formulation; docs/performance.md
+    "Quantized training" states the contract and this limit.
+    """
+    from fms_fsdp_tpu.ops.quant import (
+        delayed_scale,
+        leaf_amax,
+        roll_amax_history,
+        wire_roundtrip,
+    )
+
+    if mode in ("int8", "fp8"):
+        return jax.tree.map(lambda g: wire_roundtrip(g, mode), grads), quant_state
+    if mode != "fp8_delayed":
+        raise ValueError(f"unknown quantized_reduce mode: {mode!r}")
+    history = quant_state["amax_history"]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+    out_leaves = []
+    new_hist = {}
+    for path, g in flat:
+        key = quant_leaf_key(path)
+        amax = leaf_amax(g)
+        scale = delayed_scale(history[key], amax)
+        out_leaves.append(wire_roundtrip(g, "fp8_delayed", scale=scale))
+        new_hist[key] = roll_amax_history(history[key], amax)
+    grads = jax.tree_util.tree_unflatten(treedef, out_leaves)
+    return grads, {"amax_history": new_hist}
+
+
 def shard_params(params, specs, mesh: Mesh):
     """Place a param pytree on the mesh per the spec tree (host -> device).
 

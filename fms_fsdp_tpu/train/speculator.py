@@ -15,6 +15,7 @@ chunking (ref:train_speculator_utils.py:327-338, 158-162, 224-232) has no
 analog here — inputs are global arrays and GSPMD handles any tensor axis.
 """
 
+import logging
 import math
 import os
 import time
@@ -27,6 +28,44 @@ import optax
 from fms_fsdp_tpu.models import get_base_api
 from fms_fsdp_tpu.models.speculator import SpeculatorConfig, speculator_forward
 from fms_fsdp_tpu.train.step import cross_entropy_loss
+
+logger = logging.getLogger(__name__)
+
+# quantized_matmuls values the step builder had to ignore (non-llama
+# base archs drop the flag through their **_unused kwargs). Pending
+# count drains into the observer registry as the
+# ``speculator.quant_ignored`` counter once the loop attaches one —
+# builders run before the observer exists, so the note is buffered.
+_QUANT_IGNORED_WARNED = set()
+_QUANT_IGNORED_PENDING = 0
+
+
+def _note_quant_ignored(quant: str, arch: str) -> int:
+    """One-shot warning + buffered obs count for a quantized_matmuls
+    request the base arch cannot honor. Returns the pending count."""
+    global _QUANT_IGNORED_PENDING
+    _QUANT_IGNORED_PENDING += 1
+    key = (quant, arch)
+    if key not in _QUANT_IGNORED_WARNED:
+        _QUANT_IGNORED_WARNED.add(key)
+        logger.warning(
+            "quantized_matmuls=%r is not supported for the %r speculator "
+            "base arch (only llama bases thread quant= through the frozen "
+            "forward); training proceeds UNQUANTIZED. Recorded as the "
+            "speculator.quant_ignored obs counter.",
+            quant, arch,
+        )
+    return _QUANT_IGNORED_PENDING
+
+
+def _drain_quant_ignored(registry) -> None:
+    """Flush buffered quant-ignored notes into an obs registry."""
+    global _QUANT_IGNORED_PENDING
+    if _QUANT_IGNORED_PENDING and registry is not None:
+        registry.counter("speculator.quant_ignored").add(
+            _QUANT_IGNORED_PENDING
+        )
+        _QUANT_IGNORED_PENDING = 0
 
 
 def get_speculator_lr_schedule(cfg, start_step: int = 0):
@@ -90,10 +129,14 @@ def make_stage1_step(
     configure_flash_variant(getattr(cfg, "flash_kernel_variant", None))
     n_predict = scfg.n_predict
     schedule = get_speculator_lr_schedule(cfg)
-    # int8 base forward: the frozen teacher's GEMMs can run on the MXU
-    # int8 path too — Llama bases only (the other archs would silently
-    # ignore the flag through their **_unused kwargs)
-    quant = cfg.quantized_matmuls if base_api.arch == "llama" else "none"
+    # int8/fp8 base forward: the frozen teacher's GEMMs can run on the
+    # MXU quantized path too — Llama bases only (the other archs would
+    # silently ignore the flag through their **_unused kwargs, so a
+    # non-llama request is warned once and counted in obs)
+    quant = getattr(cfg, "quantized_matmuls", "none") or "none"
+    if base_api.arch != "llama" and quant != "none":
+        _note_quant_ignored(quant, base_api.arch)
+        quant = "none"
 
     def loss_fn(spec_params, inputs):
         _, embeds = base_api.forward_embeds(
@@ -263,6 +306,16 @@ def train_speculator(
         from fms_fsdp_tpu.obs import build_observer
 
         observer = build_observer(cfg, rank)
+    # the stage builders ran before the observer existed: land any
+    # ignored-quant notes in THIS run's registry
+    _drain_quant_ignored(observer.registry)
+    # a perf record must state the numerics that actually ran: when the
+    # builders dropped the quant flag (non-llama base, warned above),
+    # the v4 quantized_matmuls field must say "none", not the config's
+    # ignored request
+    arch = base_api.arch if base_api is not None else "llama"
+    if arch != "llama" and getattr(observer, "quantized_matmuls", None):
+        observer.quantized_matmuls = "none"
     checkpointer.observer = observer
     train_loader = observer.wrap_data_iter(train_loader)
 

@@ -25,6 +25,8 @@ from fms_fsdp_tpu.parallel.mixed_precision import get_dtype_policy
 from fms_fsdp_tpu.parallel.sharding import (
     batch_pspec,
     infer_state_specs,
+    init_amax_state,
+    quantized_grad_reduce,
     resolve_spec,
     tree_shardings,
 )
@@ -150,11 +152,19 @@ def init_train_state(
 
     def init_fn(rng):
         params = init_params(rng, model_cfg, dtype=policy.param_dtype)
-        return {
+        state = {
             "params": params,
             "opt_state": optimizer.init(params),
             "step": jnp.zeros((), jnp.int32),
         }
+        if policy.reduce_quant == "fp8_delayed":
+            # delayed-scaling amax history rides in the train state so
+            # it checkpoints / donates / elastic-reshards (replicated)
+            # like optimizer state
+            state["quant"] = init_amax_state(
+                params, int(getattr(cfg, "fp8_amax_history_len", 16))
+            )
+        return state
 
     shapes = jax.eval_shape(init_fn, rng)
     specs = infer_state_specs(shapes, specs_fn())
@@ -335,7 +345,13 @@ def make_train_step(
             grads = jax.tree.map(lambda g: g * poison.astype(g.dtype), grads)
         # Global-norm clip with the norm accumulated in fp32 regardless of
         # grad dtype — matches torch clip_grad_norm_ (ref:train_utils.py:96);
-        # the pre-clip norm is the value the reference logs.
+        # the pre-clip norm is the value the reference logs. Computed on
+        # the RAW backward output, before any reduce wire round-trip:
+        # the fp8_delayed wire clamps to the representable range, so an
+        # inf grad leaf would otherwise be laundered to a finite value
+        # here and the anomaly flag below would miss the poisoned batch
+        # (while still rolling amax=inf into the delayed-scaling
+        # history — permanently NaN-ing every later scale).
         gnorm = optax.global_norm(
             jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         )
@@ -344,6 +360,19 @@ def make_train_step(
         nonfinite = jnp.logical_not(
             jnp.logical_and(jnp.isfinite(loss), jnp.isfinite(gnorm))
         )
+        # Quantized gradient reduction (policy.reduce_quant): round-trip
+        # the grad tree through the scale-carrying wire format exactly
+        # where the reduce-dtype boundary sits. "none" skips the call
+        # entirely — the traced program is bit-identical to the seed
+        # step (pinned by tests/test_quant_parity.py). The clip below
+        # uses the pre-wire norm (wire noise shifts it <1%; the guard
+        # semantics above are what must never depend on the wire).
+        new_quant = state.get("quant")
+        if policy.reduce_quant != "none":
+            with jax.named_scope("quant_reduce"):
+                grads, new_quant = quantized_grad_reduce(
+                    grads, policy.reduce_quant, new_quant
+                )
         clip_scale = jnp.minimum(1.0, cfg.grad_clip_thresh / (gnorm + 1e-6))
         if guard_updates:
             # zero poisoned grads with a true select — scaling by 0 would
@@ -378,6 +407,15 @@ def make_train_step(
                 opt_state,
                 state["opt_state"],
             )
+            if new_quant is not None:
+                # a poisoned batch must not roll NaN (or a poisoned
+                # amax) into the delayed-scaling history — carry the
+                # old window forward like the moments
+                new_quant = jax.tree.map(
+                    lambda new, old: jnp.where(nonfinite, old, new),
+                    new_quant,
+                    state["quant"],
+                )
         metrics = {
             "loss": loss,
             "gnorm": gnorm,
@@ -385,9 +423,13 @@ def make_train_step(
             "nonfinite": nonfinite.astype(jnp.float32),
             **stats,
         }
-        return (
-            {"params": params, "opt_state": opt_state, "step": state["step"] + 1},
-            metrics,
-        )
+        new_state = {
+            "params": params,
+            "opt_state": opt_state,
+            "step": state["step"] + 1,
+        }
+        if new_quant is not None:
+            new_state["quant"] = new_quant
+        return new_state, metrics
 
     return train_step

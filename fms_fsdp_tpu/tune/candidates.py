@@ -72,6 +72,12 @@ _BLOCK_CHOICES = (128, 256, 512, 1024, 2048)
 _SSD_CHUNK_CHOICES = (128, 256, 512)
 _CE_CHUNK_CHOICES = (1024, 2048, 4096, 8192, 16384)
 
+# Quantized flash family: the k stream (with q, the operands of the
+# score GEMM — v is never quantized) rides in a 1-byte wire format,
+# cutting the resident family's k+v residency 1.5x vs bf16 and lifting
+# its sequence cap past 16k. None = today's unquantized kernels.
+_FLASH_QUANT_CHOICES = (None, "int8", "fp8")
+
 
 def dtype_bytes(dtype: str) -> int:
     return DTYPE_BYTES.get(str(dtype), 4)
@@ -100,47 +106,47 @@ def flash_sig(q_shape, k_shape) -> Dict[str, int]:
     }
 
 
-def _flash_fwd_resident_bytes(sig, db, bq):
+def _flash_fwd_resident_bytes(sig, db, bq, kv_db):
     h, sk = sig["head"], sig["seq_k"]
-    kv = 2 * sk * h * db * _DB  # k + v, whole per-head stream
+    kv = sk * h * (kv_db + db) * _DB  # k (wire width) + v, whole stream
     q_o = 2 * bq * h * db * _DB  # q in + o out blocks
     lse = bq * 4 * _DB
     acc = bq * h * 4 + 2 * bq * 4  # fp32 acc + running max/denominator
     return kv + q_o + lse + acc
 
 
-def _flash_fwd_kvgrid_bytes(sig, db, bq, bk):
+def _flash_fwd_kvgrid_bytes(sig, db, bq, bk, kv_db):
     h = sig["head"]
-    kv = 2 * bk * h * db * _DB
+    kv = bk * h * (kv_db + db) * _DB
     q_o = 2 * bq * h * db * _DB
     lse = bq * 4 * _DB
     scratch = bq * h * 4 + 2 * bq * 4  # VMEM scratch: acc, m, l
     return kv + q_o + lse + scratch
 
 
-def _flash_dq_resident_bytes(sig, db, bq):
+def _flash_dq_resident_bytes(sig, db, bq, kv_db):
     h, sk = sig["head"], sig["seq_k"]
-    kv = 2 * sk * h * db * _DB
+    kv = sk * h * (kv_db + db) * _DB
     blocks = 3 * bq * h * db * _DB  # q, do in + dq out
     stats = 2 * bq * 4 * _DB  # lse, delta
     acc = bq * h * 4  # fori-loop fp32 dq accumulator
     return kv + blocks + stats + acc
 
 
-def _flash_dq_kvgrid_bytes(sig, db, bq, bk):
+def _flash_dq_kvgrid_bytes(sig, db, bq, bk, kv_db):
     h = sig["head"]
-    kv = 2 * bk * h * db * _DB
+    kv = bk * h * (kv_db + db) * _DB
     blocks = 3 * bq * h * db * _DB
     stats = 2 * bq * 4 * _DB
     scratch = bq * h * 4
     return kv + blocks + stats + scratch
 
 
-def _flash_dkv_bytes(sig, db, bq, bk):
+def _flash_dkv_bytes(sig, db, bq, bk, kv_db):
     # shared by both families: kv blocks resident across the (g, qi)
     # sweep, q/do streamed, two fp32 scratch accumulators
     h = sig["head"]
-    kv_blocks = 2 * bk * h * db * _DB
+    kv_blocks = bk * h * (kv_db + db) * _DB
     dkv_out = 2 * bk * h * 4 * _DB  # fp32 outputs
     q_do = 2 * bq * h * db * _DB
     stats = 2 * bq * 4 * _DB
@@ -149,17 +155,23 @@ def _flash_dkv_bytes(sig, db, bq, bk):
 
 
 def flash_vmem_bytes(family: str, sig: Dict[str, int], dtype: str,
-                     block_q: int, block_k: int) -> int:
+                     block_q: int, block_k: int,
+                     quant: Optional[str] = None) -> int:
     """Worst-case per-core VMEM over the kernels a training step runs
-    (fwd + dq + dkv) for one family/tile choice."""
+    (fwd + dq + dkv) for one family/tile choice. ``quant`` ("int8" /
+    "fp8") prices the k stream at its 1-byte wire width — v stays
+    full-width (only q/k ride the wire, ops/flash_attention.py). The
+    per-block scale vectors are O(block) fp32, noise against the
+    O(block*head) operands."""
     db = dtype_bytes(dtype)
+    kv_db = 1 if quant else db
     if family == "resident":
-        fwd = _flash_fwd_resident_bytes(sig, db, block_q)
-        dq = _flash_dq_resident_bytes(sig, db, block_q)
+        fwd = _flash_fwd_resident_bytes(sig, db, block_q, kv_db)
+        dq = _flash_dq_resident_bytes(sig, db, block_q, kv_db)
     else:
-        fwd = _flash_fwd_kvgrid_bytes(sig, db, block_q, block_k)
-        dq = _flash_dq_kvgrid_bytes(sig, db, block_q, block_k)
-    dkv = _flash_dkv_bytes(sig, db, block_q, block_k)
+        fwd = _flash_fwd_kvgrid_bytes(sig, db, block_q, block_k, kv_db)
+        dq = _flash_dq_kvgrid_bytes(sig, db, block_q, block_k, kv_db)
+    dkv = _flash_dkv_bytes(sig, db, block_q, block_k, kv_db)
     return max(fwd, dq, dkv)
 
 
@@ -173,23 +185,25 @@ def flash_candidates(sig: Dict[str, int], dtype: str, chip: str) -> List[Dict]:
     budget = vmem_budget(chip)
     out = []
     for family in ("resident", "kvgrid"):
-        for bq in _BLOCK_CHOICES:
-            if not _legal_block(sig["seq_q"], bq):
-                continue
-            for bk in _BLOCK_CHOICES:
-                if not _legal_block(sig["seq_k"], bk):
+        for quant in _FLASH_QUANT_CHOICES:
+            for bq in _BLOCK_CHOICES:
+                if not _legal_block(sig["seq_q"], bq):
                     continue
-                vmem = flash_vmem_bytes(family, sig, dtype, bq, bk)
-                if vmem > budget:
-                    continue
-                out.append(
-                    {
+                for bk in _BLOCK_CHOICES:
+                    if not _legal_block(sig["seq_k"], bk):
+                        continue
+                    vmem = flash_vmem_bytes(family, sig, dtype, bq, bk, quant)
+                    if vmem > budget:
+                        continue
+                    c = {
                         "family": family,
                         "block_q": bq,
                         "block_k": bk,
                         "vmem_bytes": vmem,
                     }
-                )
+                    if quant:
+                        c["quant"] = quant
+                    out.append(c)
     return out
 
 
@@ -202,14 +216,17 @@ def flash_config_legal(config: Dict, sig: Dict[str, int], dtype: str,
     family = config.get("family")
     bq = config.get("block_q", FLASH_DEFAULT_BLOCK_Q)
     bk = config.get("block_k", FLASH_DEFAULT_BLOCK_K)
+    quant = config.get("quant")
     if family not in (None, "resident", "kvgrid"):
+        return False
+    if quant not in _FLASH_QUANT_CHOICES:
         return False
     if not isinstance(bq, int) or not isinstance(bk, int):
         return False
     if not (_legal_block(sig["seq_q"], bq) and _legal_block(sig["seq_k"], bk)):
         return False
     fam = family or "resident"
-    return flash_vmem_bytes(fam, sig, dtype, bq, bk) <= vmem_budget(chip)
+    return flash_vmem_bytes(fam, sig, dtype, bq, bk, quant) <= vmem_budget(chip)
 
 
 def resident_max_seq(head: int, dtype: str, chip: str,

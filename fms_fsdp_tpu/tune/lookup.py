@@ -206,24 +206,36 @@ def _lookup(kernel: str, sig: Dict[str, int], dtype: str,
 # ---------------------------------------------------------------------------
 
 
+_FLASH_QUANT_CODE = {"none": 0, "int8": 1, "fp8": 2}
+
+
 def resolve_flash(q_shape, k_shape, dtype: str,
                   requested_q: Optional[int] = None,
                   requested_k: Optional[int] = None,
                   requested_variant: Optional[str] = None,
+                  requested_quant: Optional[str] = None,
                   chip: Optional[str] = None,
-                  ) -> Tuple[int, int, Optional[str], str]:
-    """(block_q, block_k, family, how) for one attention call, public
-    (B, S, N, H) layout.
+                  ) -> Tuple[int, int, Optional[str], Optional[str], str]:
+    """(block_q, block_k, family, quant, how) for one attention call,
+    public (B, S, N, H) layout.
 
     Explicitly requested pieces are always honored (callers passing
     block sizes — ring attention's bwd partials, tests — pin them); only
     unset pieces consult the table. With tuning off the static defaults
-    fill the gaps, bit-identical to the pre-tuner behavior."""
+    fill the gaps, bit-identical to the pre-tuner behavior.
+
+    ``quant`` is the quantized-family selection (None | "int8" | "fp8"):
+    a table entry carrying a ``quant`` field turns the kv wire format on
+    for this call; the committed default table carries none, so stock
+    runs stay bit-identical. The resolved mode is exported as the
+    ``kernel.tune.flash.quant_code`` gauge (0/1/2) alongside the string
+    in :func:`choices`."""
     sig = cand.flash_sig(q_shape, k_shape)
     pinned = requested_q is not None and requested_k is not None
     bq = requested_q or cand.FLASH_DEFAULT_BLOCK_Q
     bk = requested_k or cand.FLASH_DEFAULT_BLOCK_K
     fam = requested_variant
+    qnt = requested_quant
     # "off" = tuning disabled; "pinned" = the caller named the tiles
     # (tuning may be on) — the record must never claim tuning was off
     # when the mode was auto
@@ -237,17 +249,21 @@ def resolve_flash(q_shape, k_shape, dtype: str,
                 bk = int(config.get("block_k", bk))
             if fam is None:
                 fam = config.get("family")
+            if qnt is None:
+                qnt = config.get("quant")
     _record(
         "flash",
         {
             "block_q": bq,
             "block_k": bk,
             "kvgrid": 1 if fam == "kvgrid" else 0,
+            "quant": qnt or "none",
+            "quant_code": _FLASH_QUANT_CODE.get(qnt or "none", 0),
             "how": how,
             "seq_k": sig["seq_k"],
         },
     )
-    return bq, bk, fam, how
+    return bq, bk, fam, qnt, how
 
 
 def record_final_flash_blocks(block_q: int, block_k: int,

@@ -170,6 +170,10 @@ def _measure_child(spec_json: str):
                     q, k, v, causal=True,
                     block_q=config["block_q"], block_k=config["block_k"],
                     variant=config.get("family"),
+                    # quant candidates must time the path production
+                    # runs: the q/k wire round-trip + kernel, not the
+                    # bare unquantized kernel
+                    quant=config.get("quant"),
                 ).astype(jnp.float32)
             )
 
@@ -333,13 +337,13 @@ def main():
         resolved = []
         for kernel, sig, dtype in SUITE:
             if kernel == "flash_attention":
-                bq, bk, fam, how = resolve_flash(
+                bq, bk, fam, qnt, how = resolve_flash(
                     (sig["batch"], sig["seq_q"], sig["nq"], sig["head"]),
                     (sig["batch"], sig["seq_k"], sig["nkv"], sig["head"]),
                     dtype, chip=chip,
                 )
                 r = {"block_q": bq, "block_k": bk, "family": fam,
-                     "how": how}
+                     "quant": qnt, "how": how}
             elif kernel == "ssd":
                 L = resolve_ssd_chunk(
                     (sig["batch"], sig["seq"], sig["heads"],

@@ -16,7 +16,9 @@ arrow data, with two observation hooks the elastic-resume contract needs:
 Env contract (set by the parent test): JAX_PLATFORMS=cpu, XLA_FLAGS with
 xla_force_host_platform_device_count=4, COORDINATOR_ADDRESS,
 NUM_PROCESSES, PROCESS_ID. argv: ckpt_dir data_path walk_dir phase
-num_steps ckpt_interval [faults].
+num_steps ckpt_interval [faults] [key=value overrides...] — overrides
+are extra TrainConfig fields (e.g. quantized_reduce=fp8_delayed for the
+amax-state elastic round-trip test).
 
 The orchestration mirrors main_training_llama.main (checkpoint manager
 BEFORE the loader, resume_topology -> elastic_batch_size ->
@@ -76,7 +78,8 @@ def _walk_logged(feed, walk_path):
             yield batch
 
 
-def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval, faults):
+def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
+        faults, overrides=()):
     import jax
 
     from fms_fsdp_tpu.ckpt import build_checkpoint_manager
@@ -125,6 +128,7 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval, faults):
         ckpt_save_path=ckpt_dir,
         ckpt_load_path=ckpt_dir,
         faults=faults,
+        **dict(kv.split("=", 1) for kv in overrides),
     )
     if cfg.faults:
         from fms_fsdp_tpu.resilience.faults import configure_faults
@@ -186,6 +190,14 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval, faults):
     print("START_STEP", start_step, flush=True)
     print("TOKENS_SEEN", tokens_seen, flush=True)
     print("STATE_HASH", _state_hash(state, mesh), flush=True)
+    if "quant" in state:
+        # delayed-scaling rows with a live (nonzero) newest amax — a
+        # resume that silently re-initialized the history would print 0
+        nz = sum(
+            int(np.asarray(row)[0] > 0)
+            for row in state["quant"]["amax_history"].values()
+        )
+        print("QUANT_AMAX_NONZERO", nz, flush=True)
 
     if num_steps > start_step:
         step_fn = make_train_step(model_cfg, cfg, mesh, optimizer)
@@ -219,4 +231,5 @@ if __name__ == "__main__":
         int(sys.argv[5]),
         int(sys.argv[6]),
         sys.argv[7] if len(sys.argv) > 7 else "",
+        sys.argv[8:],
     )

@@ -13,6 +13,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -68,3 +70,65 @@ def test_bench_smoke_schema():
     # a measured run is never degraded
     assert not out.get("degraded"), out
     assert out["bf16_mfu"] is not None and out["bf16_vs_baseline"] is not None
+    # the fp8 sibling fields always ride at top level (null when the
+    # fp8 row is outside the BENCH_ROWS selection, as here)
+    assert "fp8_mfu" in out and "fp8_vs_baseline" in out
+
+
+def test_fp8_sibling_located_structurally():
+    """The fp8 headline sibling is found by kwargs identity (minus
+    quant), like the bf16 one — reordering ROWS can't mislabel it."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    label = bench._fp8_sibling_label()
+    assert label is not None and "fp8" in label
+    kw = dict(next(kw for lb, kw in bench.ROWS if lb == label))
+    head = dict(bench.ROWS[0][1])
+    assert kw.pop("quant") in ("fp8", "fp8_dgrad")
+    head.pop("quant")
+    assert kw == head
+
+
+@pytest.mark.slow
+def test_bench_fallback_tier_measures_on_cpu_host():
+    """The acceptance contract: `python bench.py` on a CPU-only host
+    (TPU probe unavailable) emits a MEASURED headline — an explicit
+    fallback_backend tier with a bf16-vs-int8-vs-fp8 relative number
+    and real rows, never vs_baseline: null with empty rows — and
+    BENCH_STRICT accepts it (degraded: false)."""
+    env = dict(os.environ)
+    env.pop("BENCH_FORCE_CPU", None)
+    env.pop("BENCH_SMOKE", None)
+    env.update(
+        JAX_PLATFORMS="cpu",  # the probe answers, as a cpu backend
+        BENCH_STRICT="1",
+        BENCH_FALLBACK_STEPS="2",
+        BENCH_FALLBACK_SEQ="256",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=1800,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    line = [
+        ln for ln in proc.stdout.splitlines() if ln.startswith("{")
+    ][-1]
+    out = json.loads(line)
+    assert out["degraded"] is False
+    assert out["fallback_backend"] == "cpu"
+    assert "probe_error" in out
+    # a real relative number: bf16 vs int8 vs fp8 all measured
+    rel = out["quant_relative"]
+    assert rel["int8"] > 0 and rel["fp8"] > 0
+    assert out["value"] == rel["int8"]
+    assert out["rows"] and all("error" not in r for r in out["rows"])
+    quants = {r["quant"] for r in out["rows"]}
+    assert quants == {"none", "int8", "fp8"}

@@ -509,30 +509,46 @@ def test_elastic_resume_world2_to_world1(tmp_path):
     same-topology resume is a fingerprint no-op; a world=1 resume
     restores bit-identically onto the new mesh, preserves the global
     batch (per-rank rows 2 -> 4), and continues the trainer-consumed
-    document stream with zero replayed documents."""
+    document stream with zero replayed documents.
+
+    The run trains with quantized_reduce="fp8_delayed", so the
+    delayed-scaling amax history rides in the train state: STATE_HASH
+    equality across worlds pins that the quant subtree elastic-reshards
+    bit-identically, and QUANT_AMAX_NONZERO pins that the restored
+    history is the live one (a silent re-init would print 0)."""
     data = _marked_corpus(tmp_path / "data")
     ckpt = str(tmp_path / "ckpt")
     walk = str(tmp_path / "walk")
     os.makedirs(walk)
+    quant = ["", "quantized_reduce=fp8_delayed"]
 
-    rcs, outs = _launch_world(2, [ckpt, data, walk, "save", "4", "4"])
+    rcs, outs = _launch_world(
+        2, [ckpt, data, walk, "save", "4", "4", *quant]
+    )
     assert rcs == [0, 0], outs[0][-3000:] + outs[1][-3000:]
 
     # same-topology restart: the fingerprint check is a no-op
-    rcs, outs_same = _launch_world(2, [ckpt, data, walk, "same", "4", "4"])
+    rcs, outs_same = _launch_world(
+        2, [ckpt, data, walk, "same", "4", "4", *quant]
+    )
     assert rcs == [0, 0], outs_same[0][-3000:] + outs_same[1][-3000:]
     assert _grab(outs_same[0], "START_STEP") == "4"
     assert "Elastic resume" not in outs_same[0], outs_same[0][-3000:]
     ref_hash = _grab(outs_same[0], "STATE_HASH")
     assert _grab(outs_same[1], "STATE_HASH") == ref_hash
+    assert int(_grab(outs_same[0], "QUANT_AMAX_NONZERO")) > 0
 
     # world=1 rescale: bit-identical restore, preserved global batch,
     # seamless walk continuation
-    rcs, outs_r = _launch_world(1, [ckpt, data, walk, "resume", "8", "4"])
+    rcs, outs_r = _launch_world(
+        1, [ckpt, data, walk, "resume", "8", "4", *quant]
+    )
     assert rcs == [0], outs_r[0][-4000:]
     out = outs_r[0]
     assert _grab(out, "START_STEP") == "4"
     assert _grab(out, "STATE_HASH") == ref_hash, out[-3000:]
+    # the amax history survived the rescale as live data
+    assert int(_grab(out, "QUANT_AMAX_NONZERO")) > 0
     assert "preserving the global batch of 16 rows" in out, out[-3000:]
     assert "Elastic resume: restart topology differs" in out, out[-3000:]
     losses = [

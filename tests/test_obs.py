@@ -199,6 +199,38 @@ def _observer_record(**kw):
     return obs.report(10, 4, **args)
 
 
+def test_quant_modes_land_in_record():
+    """schema v4: the step's quantization modes ride every record; a
+    perf record must state the numerics that produced it. Built from
+    config via build_observer, null when unset."""
+    rec = _observer_record()
+    assert rec["quantized_matmuls"] is None
+    assert rec["quantized_reduce"] is None
+
+    from fms_fsdp_tpu.obs import build_observer
+
+    class Cfg:
+        obs_dir = ""
+        obs_sinks = ""
+        kernel_tuning = "auto"
+        quantized_matmuls = "int8_dgrad"
+        quantized_reduce = "fp8_delayed"
+        seq_length = 64
+
+    obs = build_observer(Cfg(), rank=0, clock=FakeClock())
+    rec = obs.report(
+        10,
+        4,
+        loss=2.5,
+        tokens_per_sec_per_chip=1000.0,
+        skipped_steps_total=0,
+        skipped_steps_window=0,
+    )
+    assert rec["quantized_matmuls"] == "int8_dgrad"
+    assert rec["quantized_reduce"] == "fp8_delayed"
+    assert validate_record(rec) == []
+
+
 def test_checkpoint_stats_provider_feeds_record():
     """schema v2: the async checkpoint manager's stats provider fills
     checkpoint_bg_s / checkpoint_in_flight; without a provider both

@@ -192,3 +192,61 @@ def test_stage2_runs(base_params):
     state, m = step(state, inputs, jax.random.PRNGKey(9))
     assert np.isfinite(float(m["loss"]))
     assert m["per_head"].shape == (2,)
+
+
+def test_quant_ignored_for_non_llama_base_warns_and_counts(base_params, caplog):
+    """A quantized_matmuls request the base arch can't honor must not
+    be silently dropped: one-shot warning + speculator.quant_ignored
+    obs counter (drained into the registry the loop attaches)."""
+    import logging
+
+    from fms_fsdp_tpu.models import BaseModelAPI, get_base_api
+    from fms_fsdp_tpu.obs.registry import MetricRegistry
+    from fms_fsdp_tpu.train import speculator as spec_mod
+
+    cfg = TrainConfig(
+        seq_length=32,
+        batch_size=4,
+        num_steps=100,
+        stage2_start_step=50,
+        n_speculator_heads=3,
+        speculator_width=32,
+        quantized_matmuls="int8",
+        attention_kernel="xla",
+    )
+    scfg, state, opt = _spec_setup(base_params, cfg)
+    llama_api = get_base_api("embedllama")
+    # a llama-shaped API claiming a non-llama arch: the forward still
+    # works (llama accepts quant=), but the builder must treat it as
+    # unsupported and fall back to quant="none"
+    fake = BaseModelAPI(
+        "mamba", llama_api.init, llama_api.forward_embeds,
+        llama_api.generate, llama_api.param_specs,
+    )
+    spec_mod._QUANT_IGNORED_WARNED.clear()
+    spec_mod._QUANT_IGNORED_PENDING = 0
+    with caplog.at_level(logging.WARNING, logger="fms_fsdp_tpu.train.speculator"):
+        step = make_stage1_step(base_params, TINY, scfg, cfg, opt, base_api=fake)
+        # second build: the warning is one-shot per (quant, arch)
+        make_stage1_step(base_params, TINY, scfg, cfg, opt, base_api=fake)
+    warns = [r for r in caplog.records
+             if "quantized_matmuls" in r.getMessage()]
+    assert len(warns) == 1, [r.getMessage() for r in caplog.records]
+    assert "mamba" in warns[0].getMessage()
+    # both ignored builds drain into the attached registry
+    reg = MetricRegistry()
+    spec_mod._drain_quant_ignored(reg)
+    assert reg.snapshot()["speculator.quant_ignored"] == 2
+    assert spec_mod._QUANT_IGNORED_PENDING == 0
+    # the built step still trains (unquantized)
+    inputs = jax.random.randint(jax.random.PRNGKey(7), (4, 32), 0, 128)
+    state, m = step(state, inputs)
+    assert np.isfinite(float(m["loss"]))
+    # a llama base honors the flag without warning
+    spec_mod._QUANT_IGNORED_WARNED.clear()
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="fms_fsdp_tpu.train.speculator"):
+        make_stage1_step(base_params, TINY, scfg, cfg, opt, base_api=llama_api)
+    assert not [r for r in caplog.records
+                if "quantized_matmuls" in r.getMessage()]
+    assert spec_mod._QUANT_IGNORED_PENDING == 0

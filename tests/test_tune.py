@@ -172,13 +172,40 @@ def test_cost_model_matches_resident_cap():
 def test_flash_candidates_prune_resident_past_cap():
     sig16k = {"batch": 1, "nq": 8, "nkv": 8, "seq_q": 16384,
               "seq_k": 16384, "head": 128}
-    fams = {c["family"] for c in
-            cand.flash_candidates(sig16k, "bfloat16", "v5e")}
-    assert fams == {"kvgrid"}  # resident cannot fit 16k in VMEM
+    cands16k = cand.flash_candidates(sig16k, "bfloat16", "v5e")
+    fams = {c["family"] for c in cands16k if not c.get("quant")}
+    assert fams == {"kvgrid"}  # bf16 resident cannot fit 16k in VMEM
+    # the quantized family's 1-byte kv stream is exactly what lifts the
+    # resident cap past 16k — the candidate set must reflect it
+    assert {"resident", "kvgrid"} == {
+        c["family"] for c in cands16k if c.get("quant")
+    }
     sig4k = dict(sig16k, seq_q=4096, seq_k=4096)
     fams = {c["family"] for c in
             cand.flash_candidates(sig4k, "bfloat16", "v5e")}
     assert fams == {"resident", "kvgrid"}
+
+
+def test_flash_quant_candidates_enumerated_and_cheaper():
+    """Every block choice is enumerated across the quant axis (None /
+    int8 / fp8), and the quantized kv stream prices below bf16 for the
+    same family/tiles."""
+    sig = {"batch": 1, "nq": 8, "nkv": 8, "seq_q": 4096, "seq_k": 4096,
+           "head": 128}
+    cands = cand.flash_candidates(sig, "bfloat16", "v5e")
+    quants = {c.get("quant") for c in cands}
+    assert quants == {None, "int8", "fp8"}
+    bf16 = cand.flash_vmem_bytes("resident", sig, "bfloat16", 512, 512)
+    q8 = cand.flash_vmem_bytes("resident", sig, "bfloat16", 512, 512,
+                               quant="int8")
+    assert q8 < bf16
+    # legality check accepts a quant-carrying config and rejects junk
+    assert cand.flash_config_legal(
+        {"family": "resident", "block_q": 512, "block_k": 512,
+         "quant": "int8"}, sig, "bfloat16", "v5e")
+    assert not cand.flash_config_legal(
+        {"family": "resident", "block_q": 512, "block_k": 512,
+         "quant": "int4"}, sig, "bfloat16", "v5e")
 
 
 def test_kvgrid_footprint_independent_of_seq():
@@ -218,7 +245,7 @@ def test_illegal_table_config_falls_back_to_default(tmp_path):
           {"family": "resident", "block_q": 1024, "block_k": 384})],
     )
     lookup.configure_kernel_tuning("auto", path, chip="v5e")
-    bq, bk, fam, how = lookup.resolve_flash(
+    bq, bk, fam, qnt, how = lookup.resolve_flash(
         (1, 512, 4, 128), (1, 512, 2, 128), "bfloat16")
     assert (bq, bk) == (cand.FLASH_DEFAULT_BLOCK_Q,
                         cand.FLASH_DEFAULT_BLOCK_K)
@@ -237,14 +264,14 @@ def test_resolve_flash_auto_vs_off(tmp_path):
           {"family": "kvgrid", "block_q": 256, "block_k": 128})],
     )
     lookup.configure_kernel_tuning("auto", path, chip="v5e")
-    bq, bk, fam, how = lookup.resolve_flash(
+    bq, bk, fam, qnt, how = lookup.resolve_flash(
         (1, 512, 4, 128), (1, 512, 2, 128), "bfloat16")
-    assert (bq, bk, fam, how) == (256, 128, "kvgrid", "exact")
+    assert (bq, bk, fam, qnt, how) == (256, 128, "kvgrid", None, "exact")
 
     lookup.configure_kernel_tuning("off")
-    bq, bk, fam, how = lookup.resolve_flash(
+    bq, bk, fam, qnt, how = lookup.resolve_flash(
         (1, 512, 4, 128), (1, 512, 2, 128), "bfloat16")
-    assert (bq, bk, fam, how) == (512, 512, None, "off")
+    assert (bq, bk, fam, qnt, how) == (512, 512, None, None, "off")
 
 
 def test_resolve_flash_explicit_blocks_pinned(tmp_path):
@@ -254,7 +281,7 @@ def test_resolve_flash_explicit_blocks_pinned(tmp_path):
           {"family": "kvgrid", "block_q": 256, "block_k": 128})],
     )
     lookup.configure_kernel_tuning("auto", path, chip="v5e")
-    bq, bk, fam, how = lookup.resolve_flash(
+    bq, bk, fam, qnt, how = lookup.resolve_flash(
         (1, 512, 4, 128), (1, 512, 2, 128), "bfloat16",
         requested_q=128, requested_k=256)
     assert (bq, bk) == (128, 256)  # caller wins over the table
@@ -373,9 +400,12 @@ def test_committed_table_resolves_bench_shapes_via_lookup_api():
     """kernel_tuning="auto" + the committed table: the bench-shape tile
     choices come from the table (exact), per the acceptance criteria."""
     lookup.configure_kernel_tuning("auto", chip="v5e")
-    bq, bk, fam, how = lookup.resolve_flash(
+    bq, bk, fam, qnt, how = lookup.resolve_flash(
         (2, 4096, 32, 128), (2, 4096, 32, 128), "bfloat16")
     assert how == "exact" and fam in ("resident", "kvgrid")
+    # the committed table carries no quant entries: stock runs must
+    # never silently select the quantized family
+    assert qnt is None
     L = lookup.resolve_ssd_chunk((2, 4096, 128, 64), 1, 128, "bfloat16",
                                  requested=256)
     assert lookup.choices()["ssd"]["how"] == "exact" and 4096 % L == 0
@@ -419,6 +449,101 @@ def test_flash_tuned_blocks_engage_and_match(tmp_path):
     lookup.configure_kernel_tuning("off")
     ref = flash_attention(q, k, k, interpret=True)
     assert jnp.allclose(out, ref, atol=2e-5)
+
+
+def test_flash_quant_family_from_table_engages(tmp_path):
+    """A table entry carrying ``quant`` turns on the kv wire format:
+    the output differs bitwise from the unquantized kernel (the
+    round-trip is lossy) but stays within quantization tolerance, and
+    the resolved mode lands in choices() + the quant_code gauge."""
+    from fms_fsdp_tpu.ops.flash_attention import flash_attention
+
+    path = _table_with(
+        tmp_path,
+        [("flash_attention", "cpu", "float32", FLASH_SIG,
+          {"family": "resident", "block_q": 256, "block_k": 256,
+           "quant": "int8"})],
+    )
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 512, 4, 128),
+                          jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 512, 2, 128),
+                          jnp.float32)
+    reg = MetricRegistry()
+    lookup.configure_kernel_tuning("auto", path, chip="cpu")
+    lookup.attach_registry(reg)
+    out = flash_attention(q, k, k, interpret=True)
+    ch = lookup.choices()["flash"]
+    assert (ch["quant"], ch["quant_code"], ch["how"]) == ("int8", 1, "exact")
+    assert reg.snapshot()["kernel.tune.flash.quant_code"] == 1
+    lookup.configure_kernel_tuning("off")
+    ref = flash_attention(q, k, k, interpret=True)
+    assert lookup.choices()["flash"]["quant_code"] == 0
+    assert not jnp.array_equal(out, ref)  # the wire format engaged
+    # int8 per-row q/k round-trip: scores shift by O(1/127) per operand
+    assert jnp.allclose(out, ref, atol=0.05), float(
+        jnp.max(jnp.abs(out - ref))
+    )
+
+
+def test_flash_quant_family_gradients_flow(tmp_path):
+    """The straight-through wire round-trip must keep flash_attention
+    differentiable: grads are finite and close to the unquantized
+    kernel's (the STE passes cotangents through unchanged)."""
+    from fms_fsdp_tpu.ops.flash_attention import flash_attention
+
+    path = _table_with(
+        tmp_path,
+        [("flash_attention", "cpu", "float32", FLASH_SIG,
+          {"family": "resident", "block_q": 256, "block_k": 256,
+           "quant": "fp8"})],
+    )
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 512, 4, 128),
+                          jnp.float32)
+    lookup.configure_kernel_tuning("auto", path, chip="cpu")
+
+    def loss(q):
+        return flash_attention(q, q, q, interpret=True).sum()
+
+    g_q = jax.grad(loss)(q)
+    assert bool(jnp.all(jnp.isfinite(g_q)))
+    lookup.configure_kernel_tuning("off")
+    g_r = jax.grad(loss)(q)
+    rel = float(jnp.linalg.norm(g_q - g_r) / jnp.linalg.norm(g_r))
+    assert rel < 0.1, rel
+
+
+def test_flash_quant_resident_past_cap_executes_kvgrid(tmp_path):
+    """The cost model legalizes quantized resident past the bf16 8k cap
+    (1-byte kv stream), but today's SIMULATED execution runs the
+    full-width unquantized kernel — a table entry claiming resident at
+    16k must execute as kvgrid (and the record must say so), not launch
+    a bf16 resident kernel past its VMEM cap."""
+    from fms_fsdp_tpu.ops.flash_attention import (
+        MAX_KERNEL_SEQ,
+        flash_attention,
+    )
+
+    seq = 2 * MAX_KERNEL_SEQ
+    sig = {"batch": 1, "nq": 2, "nkv": 2, "seq_q": seq, "seq_k": seq,
+           "head": 128}
+    # the candidate really is cost-model legal on v5e...
+    assert cand.flash_config_legal(
+        {"family": "resident", "block_q": 512, "block_k": 512,
+         "quant": "int8"}, sig, "bfloat16", "v5e")
+    path = _table_with(
+        tmp_path,
+        [("flash_attention", "cpu", "bfloat16", sig,
+          {"family": "resident", "block_q": 512, "block_k": 512,
+           "quant": "int8"})],
+    )
+    lookup.configure_kernel_tuning("auto", path, chip="cpu")
+    q = jax.ShapeDtypeStruct((1, seq, 2, 128), jnp.bfloat16)
+    jax.eval_shape(
+        lambda q, k, v: flash_attention(q, k, v, interpret=True), q, q, q
+    )
+    ch = lookup.choices()["flash"]
+    # ...but what ran is the kv-streamed family, quant wire engaged
+    assert ch["quant"] == "int8" and ch["kvgrid"] == 1
 
 
 def test_ssd_tuned_chunk_engages_and_matches(tmp_path):
@@ -578,8 +703,13 @@ def test_autotune_dry_run_candidates_and_pruning():
         pick = ak._cost_model_pick(kernel, sig, cands, dtype, "v5e")
         assert pick  # a pick always exists
         if kernel == "flash_attention" and sig["seq_k"] > 8192:
-            # past the resident cap every candidate is kv-streamed
-            assert all(c["family"] == "kvgrid" for c in cands)
+            # past the bf16 resident cap every UNQUANTIZED candidate is
+            # kv-streamed; quantized kv (1-byte stream) may stay resident
+            assert all(
+                c["family"] == "kvgrid"
+                for c in cands
+                if not c.get("quant")
+            )
     assert set(by_kernel) == {"flash_attention", "ssd", "fused_ce"}
 
 
@@ -610,6 +740,7 @@ def test_bench_probe_timeout_is_degraded_and_strict_fails():
         BENCH_FORCE_CPU="1",
         BENCH_PROBE_TIMEOUT_S="0.05",  # guaranteed probe timeout
         BENCH_STRICT="1",
+        BENCH_FALLBACK="0",  # bare degraded record (no measured tier)
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
