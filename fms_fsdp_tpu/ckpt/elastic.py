@@ -36,13 +36,22 @@ import json
 import os
 from typing import Dict, List, Optional, Tuple
 
-TOPOLOGY_VERSION = 1
+TOPOLOGY_VERSION = 2
 
 # name -> type tag. The topology fingerprint stamped into every
 # checkpoint's metadata.json (key "topology"). ``loader_files`` is the
 # number of per-rank loader_state files the save wrote (0 when no
 # dataloader rode along) == process_count * num_workers of the saving
 # run; it is the world size the loader state reshards FROM.
+#
+# v2 adds the slice dims (multi-slice DCN meshes, parallel/mesh.py):
+# ``num_slices`` (the dcn-axis extent / fault-domain count) and the
+# per-slice process/device shape. The slice is the FAULT DOMAIN:
+# ``check_rescale`` admits slice-count changes (a lost or regained
+# slice) but pins the per-slice shape while multi-slice — capacity that
+# comes back in different slice sizes must restart single-slice or
+# matching. Old (v1) fingerprints lack the fields; they load with a
+# note and skip the slice checks.
 TOPOLOGY_FIELDS = {
     "process_count": "int",
     "device_count": "int",
@@ -52,6 +61,9 @@ TOPOLOGY_FIELDS = {
     "seq_length": "int",
     "n_logical_shards": "int",
     "loader_files": "int",
+    "num_slices": "int",
+    "slice_process_count": "int",
+    "slice_device_count": "int",
 }
 
 # Digest of the canonical field serialization per published version; a
@@ -59,6 +71,9 @@ TOPOLOGY_FIELDS = {
 # changed without a version bump (pinned in CI, tests/test_elastic.py).
 TOPOLOGY_DIGESTS = {
     1: "a8d823b4a35b82fa1e2c91d376e485caf15a6f4558edfe0696426dd7ea129334",
+    # v2: + num_slices / slice_process_count / slice_device_count (the
+    # multi-slice fault-domain dims)
+    2: "41468023883ed0cf352f1e808cef04a5b5788ecb5f44d8d033773ec6ba2b66fe",
 }
 
 
@@ -91,11 +106,15 @@ def current_fingerprint(
     actually rides along."""
     import jax
 
+    from fms_fsdp_tpu.parallel.mesh import process_slice_context
+
     pc = jax.process_count() if process_count is None else int(process_count)
     dc = jax.device_count() if device_count is None else int(device_count)
     data_extent = data_parallel_rows_extent(cfg, dc)
     stateful_loader = not bool(getattr(cfg, "use_dummy_dataset", False))
     workers = max(1, int(getattr(cfg, "num_workers", 1) or 1))
+    n_slices, _ = process_slice_context(cfg)
+    n_slices = max(1, int(n_slices))
     return {
         "process_count": pc,
         "device_count": dc,
@@ -109,6 +128,12 @@ def current_fingerprint(
         "seq_length": int(cfg.seq_length),
         "n_logical_shards": int(getattr(cfg, "logical_shards", 0) or 0),
         "loader_files": pc * workers if stateful_loader else 0,
+        # fault-domain dims: slices partition processes/devices evenly
+        # (parallel/mesh.py raises at mesh build otherwise, before any
+        # save can stamp a torn shape)
+        "num_slices": n_slices,
+        "slice_process_count": max(1, pc // n_slices),
+        "slice_device_count": max(1, dc // n_slices),
     }
 
 
@@ -171,6 +196,38 @@ def check_rescale(
     if not changed:
         return [], False
     problems: List[str] = []
+
+    # Slice fault-domain legality (docs/checkpointing.md "Elastic
+    # resume", docs/resilience.md "Slice fault domains"): the slice is
+    # the unit capacity is lost or regained in, so a changed SLICE COUNT
+    # is legal (the batch policy recomputes via the global-batch rules
+    # below; the loader walk reshards by fractional ownership exactly as
+    # any other rescale) — but while both worlds are multi-slice the
+    # PER-SLICE shape is pinned: an hsdp group / ICI collective layout
+    # sized for one slice shape cannot silently absorb another, and a
+    # rescale mixing both dims is almost always a mis-launched restart.
+    # A single-slice restart (new num_slices == 1) escapes the pin: it
+    # is governed by the ordinary process/device rules alone. Legacy v1
+    # fingerprints carry no slice fields (all zeros) and skip this block
+    # (the load gate prints a note).
+    old_s = int(old.get("num_slices") or 0)
+    new_s = int(new.get("num_slices") or 0)
+    if old_s > 1 and new_s > 1:
+        for field, unit in (
+            ("slice_process_count", "process(es)"),
+            ("slice_device_count", "device(s)"),
+        ):
+            ov, nv = int(old.get(field) or 0), int(new.get(field) or 0)
+            if ov and nv and ov != nv:
+                problems.append(
+                    f"{field} changed across the rescale ({ov} -> {nv} "
+                    f"{unit} per slice): the slice is the fault domain — "
+                    f"rescale by whole slices of the saved shape "
+                    f"({old.get('slice_process_count')} process(es) x "
+                    f"{old.get('slice_device_count')} device(s); any "
+                    f"slice count), or restart as a single slice "
+                    f"(--num_slices=1) to rescale freely"
+                )
 
     old_logical = int(old.get("n_logical_shards") or 0)
     new_logical = int(new.get("n_logical_shards") or 0)

@@ -35,6 +35,11 @@ class TrainConfig:
     strip_tokens: str = ""
     logical_shards: int = 1024
     num_workers: int = 1
+    # reservoir-shuffle window (rows) in the loader pipeline; the
+    # reference hardcodes 10000 — configurable so small corpora (tests,
+    # debug runs) don't spin the document walk into its second epoch
+    # just filling the reservoir (see data/loader.py)
+    loader_shuffle_window: int = 10000
     # "thread" workers rely on GIL-releasing rust tokenization; "process"
     # forks workers (the reference's torch DataLoader model) for host
     # parallelism immune to GIL contention in pure-Python pipeline stages
@@ -53,6 +58,15 @@ class TrainConfig:
     tensor_parallel_size: int = 1  # "tensor" mesh axis (megatron-style TP)
     context_parallel_size: int = 1  # "context" mesh axis (ring/blockwise attention)
     expert_parallel_size: int = 1  # "expert" mesh axis (MoE expert parallelism)
+    # Multi-slice (docs/train_details.md "Multi-slice"): the outermost
+    # "dcn" data-parallel mesh axis spans TPU slices — shard/compute
+    # within a slice over ICI, all-reduce gradients across slices over
+    # DCN, with the slice as the elastic-resume fault domain. 0 =
+    # auto-detect (device slice metadata, MEGASCALE env, or the
+    # FMS_SIM_SLICES gloo-simulation knob); explicit values override the
+    # env detection (real device slice metadata, when present, stays
+    # authoritative — it reflects the physical DCN topology).
+    num_slices: int = 0
     fsdp_activation_checkpointing: bool = False
     selective_checkpointing: Union[float, str] = 1  # fraction of blocks to remat
     mixed_precision: bool = True  # bf16 compute/reduce, fp32 params (bfSixteen analog)
@@ -127,6 +141,15 @@ class TrainConfig:
     # NOT a single step's time. Checkpoint saves suspend the deadline
     # (a healthy multi-minute Orbax save must not trip it).
     step_timeout_s: float = 0.0
+    # Slice fault domains (docs/resilience.md "Slice fault domains"),
+    # multi-slice runs only: every process keeps a liveness heartbeat in
+    # this SHARED directory ("" = default to <obs_dir>/slice_health when
+    # obs_dir is set, else disabled) and the SliceHealthMonitor declares
+    # a slice lost after slice_timeout_s of silence — reporting
+    # "slice K lost, restart at world minus one fault domain" on every
+    # healthy host instead of hanging in the DCN collective. 0 disables.
+    slice_heartbeat_dir: str = ""
+    slice_timeout_s: float = 0.0
     shard_read_retries: int = 3  # bounded retries per shard IO call
     shard_read_backoff_s: float = 0.5  # initial backoff (doubles per retry)
     loader_worker_restarts: int = 2  # worker restarts before the error surfaces

@@ -935,7 +935,17 @@ def get_data_loader(cfg, rank, world_size, postprocess=None, batch_multiplier=1)
         eos_token=cfg.eol_token,
         pack_hard=True,
     )
-    data = PreloadBufferDataset(data, 10000)
+    # Reservoir-shuffle window. NOTE for tests/small corpora: while the
+    # reservoir fills it pulls ~2 rows from the packer per emitted row,
+    # so the underlying document walk runs up to (window + consumed)
+    # rows ahead of consumption — on a corpus smaller than ~2x the
+    # window's token footprint the walk wraps into its SECOND epoch
+    # almost immediately, and a resume will (correctly) re-serve
+    # epoch-1 documents. Size the window below the corpus for
+    # deterministic walk tests (tests/_elastic_child.py does).
+    data = PreloadBufferDataset(
+        data, int(getattr(cfg, "loader_shuffle_window", 10000) or 10000)
+    )
 
     data = PreprocessDataset(data, lambda x: np.asarray(x, dtype=np.int32))
     for p in postprocess:

@@ -49,6 +49,7 @@ from fms_fsdp_tpu.ops.quant import expert_matmul
 from fms_fsdp_tpu.ops.rope import rope_table
 from fms_fsdp_tpu.parallel.mesh import (
     AXIS_CONTEXT,
+    AXIS_DCN,
     AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_REPLICA,
@@ -238,8 +239,9 @@ def _expert_swiglu(xd, w1, w3, w2, quant, constrain_hidden=lambda t: t):
 @scoped("expert_ffn")
 def _expert_ffn(xd, lp, mesh, quant: str = "none"):
     """Expert SwiGLU with full GSPMD sharding: E over "expert", batch
-    over replica/fsdp, hidden width over "tensor"."""
-    ep_spec = P(AXIS_EXPERT, (AXIS_REPLICA, AXIS_FSDP), None, None)
+    over dcn/replica/fsdp (tokens never leave their slice — the a2a pair
+    stays on ICI), hidden width over "tensor"."""
+    ep_spec = P(AXIS_EXPERT, (AXIS_DCN, AXIS_REPLICA, AXIS_FSDP), None, None)
     xd = _constrain(xd, ep_spec, mesh)
     out_e = _expert_swiglu(
         xd,
@@ -248,7 +250,14 @@ def _expert_ffn(xd, lp, mesh, quant: str = "none"):
         lp["w2"],
         quant,
         lambda t: _constrain(
-            t, P(AXIS_EXPERT, (AXIS_REPLICA, AXIS_FSDP), None, AXIS_TENSOR), mesh
+            t,
+            P(
+                AXIS_EXPERT,
+                (AXIS_DCN, AXIS_REPLICA, AXIS_FSDP),
+                None,
+                AXIS_TENSOR,
+            ),
+            mesh,
         ),
     )
     return _constrain(out_e, ep_spec, mesh)
@@ -411,7 +420,7 @@ def _moe_ffn_dispatch_a2a(
         # preference through the buffer scatter into the residual stream,
         # which GSPMD can only satisfy by involuntary full remat. The
         # expert dim is manual here, so only auto axes may appear.
-        token_spec = P(None, (AXIS_REPLICA, AXIS_FSDP), None, None)
+        token_spec = P(None, (AXIS_DCN, AXIS_REPLICA, AXIS_FSDP), None, None)
         xd = _constrain(xd, token_spec, mesh)
         out = _expert_swiglu(
             xd,

@@ -1,0 +1,92 @@
+"""Report-cadence collective probe: the ICI-vs-DCN split (schema v5).
+
+On a multi-slice mesh (parallel/mesh.py: the ``dcn`` axis) the step's
+collective time has two very different transports folded into it: the
+within-slice ICI reduce-scatter/all-gather and the cross-slice DCN
+all-reduce — the bandwidth-bound hop *Memory and Bandwidth are All You
+Need for Fully Sharded Data Parallel* (PAPERS.md) says must be isolated
+and attributed. The hot loop cannot split them from the host (it only
+sees the once-per-window ``device_get``), so the Observer runs this
+probe once per report window instead: two tiny jitted reductions —
+
+- one over the within-slice data axes only (replica/fsdp/expert): its
+  collectives stay inside each slice, so its wall time tracks ICI
+  reduce latency;
+- one over the ``dcn`` axis only: a pure cross-slice all-reduce, so its
+  wall time tracks the DCN hop (including any slice skew the reduce has
+  to absorb).
+
+The seconds land in the PhaseTimer's ``ici_collective`` /
+``dcn_collective`` phases and surface as the v5 record fields. The probe
+is a latency *attribution* signal (microbenchmark at tiny shapes, once
+per window), not a bytes model — trends and ratios are the point: a
+healthy run holds both flat, a degrading DCN link (or a straggling
+slice) shows up in ``dcn_collective_s`` alone, which is exactly the
+triage split the StepWatchdog/SliceHealthMonitor reports cross-reference.
+
+Single-slice meshes get no probe at all (``make_collective_split_probe``
+returns None): nothing extra is traced, and the v5 fields stay 0.0 —
+part of the "dcn=1 adds nothing" bit-identity contract.
+
+Multi-process note: the probe's reductions are collective, so every
+process must run them at the same cadence — guaranteed because every
+rank calls ``Observer.report`` at the same step (non-zero ranks run it
+sink-less for exactly this kind of rank-consistent timing).
+"""
+
+from typing import Callable, Optional
+
+from fms_fsdp_tpu.parallel.mesh import AXIS_DCN, DATA_AXES, num_mesh_slices
+
+
+def make_collective_split_probe(mesh, timer) -> Optional[Callable[[], None]]:
+    """Build the probe for ``mesh``, recording into ``timer``'s
+    ``ici_collective`` / ``dcn_collective`` phases. None on single-slice
+    meshes (the fields then stay 0.0 and no probe program exists)."""
+    if mesh is None or num_mesh_slices(mesh) <= 1:
+        return None
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ici_axes = tuple(
+        a for a in DATA_AXES if a != AXIS_DCN and mesh.shape[a] > 1
+    )
+    lanes = 128  # one VREG lane row per shard keeps the payload trivial
+
+    def _probe_pair(axes):
+        """(jitted fn, input) summing an ``axes``-sharded vector to a
+        replicated scalar — GSPMD inserts exactly one reduction over
+        ``axes``."""
+        extent = int(np.prod([mesh.shape[a] for a in axes]))
+        sharding = NamedSharding(mesh, P(axes))
+        x = jax.make_array_from_callback(
+            (extent * lanes,),
+            sharding,
+            lambda idx: np.ones((extent * lanes,), np.float32)[idx],
+        )
+        fn = jax.jit(
+            jnp.sum, out_shardings=NamedSharding(mesh, P())
+        )
+        return fn, x
+
+    dcn_fn, dcn_x = _probe_pair((AXIS_DCN,))
+    ici = _probe_pair(ici_axes) if ici_axes else None
+    # warm both programs OUTSIDE the timed phases: the first report
+    # window must measure reduce latency, not XLA compile time — a
+    # compile-polluted first dcn_collective_s is exactly the "degrading
+    # DCN link" signature operators are told to triage on
+    dcn_fn(dcn_x).block_until_ready()
+    if ici is not None:
+        ici[0](ici[1]).block_until_ready()
+
+    def probe() -> None:
+        if ici is not None:
+            with timer.phase("ici_collective"):
+                ici[0](ici[1]).block_until_ready()
+        with timer.phase("dcn_collective"):
+            dcn_fn(dcn_x).block_until_ready()
+
+    return probe

@@ -70,9 +70,17 @@ class Observer:
         # background-write window ({bg_s, in_flight}) for the record's
         # checkpoint_bg_s / checkpoint_in_flight fields
         self._ckpt_stats: Optional[Callable[[], Dict]] = None
+        # set by the entry on multi-slice meshes (obs/collectives.py):
+        # the report-cadence probe whose timings fill the v5
+        # ici_collective_s / dcn_collective_s split; None (single-slice)
+        # leaves both fields 0.0
+        self._collective_probe: Optional[Callable[[], None]] = None
 
     def attach_checkpoint_stats(self, fn: Callable[[], Dict]) -> None:
         self._ckpt_stats = fn
+
+    def attach_collective_probe(self, fn: Optional[Callable[[], None]]) -> None:
+        self._collective_probe = fn
 
     # -- hot-loop hooks ----------------------------------------------------
 
@@ -114,6 +122,12 @@ class Observer:
 
         Returns the record (also kept as ``last_record`` for tests and
         callers that want the derived numbers)."""
+        if self._collective_probe is not None:
+            # inside the closing window, before it is folded: the
+            # probe's seconds belong to the record they attribute.
+            # Collective — every rank reports at the same step, so the
+            # probe stays rank-consistent.
+            self._collective_probe()
         window = self.timer.window()
         goodput_w, goodput_all = self.goodput.update(
             window, steps_in_window, skipped_steps_window
@@ -169,6 +183,10 @@ class Observer:
             "checkpoint_s": window["checkpoint"],
             "checkpoint_bg_s": float(ckpt_stats.get("bg_s", 0.0)),
             "checkpoint_in_flight": int(ckpt_stats.get("in_flight", 0)),
+            # v5: the multi-slice collective split (obs/collectives.py
+            # probe; 0.0 without one — single-slice runs)
+            "ici_collective_s": window.get("ici_collective", 0.0),
+            "dcn_collective_s": window.get("dcn_collective", 0.0),
             "wall_s": wall,
             "goodput": goodput_w,
             "goodput_overall": goodput_all,

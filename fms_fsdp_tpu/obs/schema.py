@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -51,6 +51,15 @@ SCHEMA_FIELDS = {
     # (checkpoint.saves.<tier>, checkpoint.bytes).
     "checkpoint_bg_s": ("float", True),
     "checkpoint_in_flight": ("int", True),
+    # v5: collective time split by transport tier (docs/observability.md
+    # "Multi-slice collective split"). On a multi-slice mesh the report-
+    # cadence collective probe (obs/collectives.py) times one tiny
+    # within-slice reduce (ICI) and one cross-slice reduce (DCN) per
+    # window, so cross-slice overhead — the HSDP scaling tax — is
+    # attributable per record. Single-slice runs report 0.0 for both
+    # (no probe is traced; the train step's HLO stays untouched).
+    "ici_collective_s": ("float", True),
+    "dcn_collective_s": ("float", True),
     "wall_s": ("float", True),
     "goodput": ("float", True),
     "goodput_overall": ("float", False),
@@ -94,6 +103,9 @@ SCHEMA_DIGESTS = {
     # gradient-reduce quantization modes; the tuner's flash quant family
     # rides in extra as kernel.tune.flash.quant_code)
     4: "488f2ccf06394fbc05445c7134628520fef64de1cd61a1bd6bf44000bd1ee66e",
+    # v5: + ici_collective_s / dcn_collective_s (the multi-slice
+    # collective split measured by the report-cadence probe)
+    5: "5b3a957aa5736c7bce67ed7650ee3f5dc6fc322bc1edb85409dcc4653eddb011",
 }
 
 

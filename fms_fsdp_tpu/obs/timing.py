@@ -12,6 +12,11 @@ The hot loop's wall clock splits into four phases:
 - ``checkpoint`` — inside ``Checkpointer.save``;
 - ``other``    — the remainder (python overhead, tracker IO, prints).
 
+Two more phases carry the multi-slice collective split
+(``ici_collective`` / ``dcn_collective``, schema v5): the report-cadence
+probe (obs/collectives.py) times one tiny within-slice reduce and one
+cross-slice reduce per window. They stay 0.0 on single-slice runs.
+
 Goodput is the fraction of wall time spent making *useful* training
 progress: compute time scaled by the window's clean-step fraction
 (steps whose updates the anomaly guard skipped produced no progress),
@@ -25,7 +30,14 @@ from contextlib import contextmanager
 from typing import Callable, Dict
 
 
-PHASES = ("data_wait", "compute", "checkpoint", "other")
+PHASES = (
+    "data_wait",
+    "compute",
+    "checkpoint",
+    "ici_collective",
+    "dcn_collective",
+    "other",
+)
 
 
 class PhaseTimer:

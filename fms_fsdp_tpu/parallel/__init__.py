@@ -1,5 +1,10 @@
 from fms_fsdp_tpu.parallel.ac import parse_ac_fraction, selective_ac_mask
-from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+from fms_fsdp_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    num_mesh_slices,
+    process_slice_context,
+)
 from fms_fsdp_tpu.parallel.mixed_precision import (
     DtypePolicy,
     bfSixteen,
@@ -9,6 +14,7 @@ from fms_fsdp_tpu.parallel.mixed_precision import (
 )
 from fms_fsdp_tpu.parallel.sharding import (
     batch_pspec,
+    hierarchical_reduce_info,
     llama_param_specs,
     shard_params,
 )
@@ -16,6 +22,9 @@ from fms_fsdp_tpu.parallel.sharding import (
 __all__ = [
     "MeshConfig",
     "build_mesh",
+    "num_mesh_slices",
+    "process_slice_context",
+    "hierarchical_reduce_info",
     "DtypePolicy",
     "bfSixteen",
     "bfSixteen_working",

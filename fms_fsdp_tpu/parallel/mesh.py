@@ -2,17 +2,24 @@
 
 The reference's sharding-strategy trichotomy (ddp / fsdp / hsdp mapping to
 NO_SHARD / FULL_SHARD / HYBRID_SHARD, ref:fms_fsdp/utils/train_utils.py:227-234)
-collapses into the *shape* of one 5-axis ``jax.sharding.Mesh``:
+collapses into the *shape* of one 6-axis ``jax.sharding.Mesh``:
 
-    ("replica", "fsdp", "expert", "context", "tensor")
+    ("dcn", "replica", "fsdp", "expert", "context", "tensor")
 
-- ddp   -> fsdp axis size 1, replica = world: params replicated, gradients
-           psum'ed over "replica" by GSPMD (NCCL all-reduce analog).
-- fsdp  -> replica 1, fsdp = world: params/opt state sharded over "fsdp";
-           XLA inserts all-gather (fwd/bwd) + reduce-scatter (grads) over ICI.
-- hsdp  -> replica = world // group, fsdp = group: shard within an ICI-local
-           group, replicate across groups (DCN on multi-slice pods) —
-           HYBRID_SHARD analog.
+- dcn   -> data-parallel axis ACROSS slices (the slowest transport: the
+           data-center network joining TPU slices on a multislice pod).
+           Size = the number of slices; params are replicated over it
+           (no spec ever names it) and gradients all-reduce across it.
+           Collapses to size 1 on single-slice — the mesh is then
+           bit-identical to the historical 5-axis construction (the
+           device array is built exactly as before and reshaped).
+- ddp   -> fsdp axis size 1, replica = per-slice world: params replicated,
+           gradients psum'ed over "replica" by GSPMD (NCCL all-reduce analog).
+- fsdp  -> replica 1, fsdp = per-slice world: params/opt state sharded over
+           "fsdp"; XLA inserts all-gather (fwd/bwd) + reduce-scatter (grads)
+           over ICI.
+- hsdp  -> replica = per-slice world // group, fsdp = group: shard within an
+           ICI-local group, replicate across groups — HYBRID_SHARD analog.
 - expert  -> expert-parallel axis (beyond-reference MoE training): MoE
            expert weights shard their E dim here, while the axis doubles as
            a data axis for dense layers (DATA_AXES) — the dispatch/combine
@@ -21,29 +28,60 @@ collapses into the *shape* of one 5-axis ``jax.sharding.Mesh``:
 - tensor  -> megatron-style TP axis (speculator parity + headroom).
 - context -> sequence/ring-attention axis (beyond-reference long-context).
 
-Axis order places "replica" outermost (slowest-varying = DCN on multi-slice)
-and "tensor" innermost (fastest ICI neighborhood).
+Axis order places "dcn" outermost (slowest-varying: whole slices), then
+"replica" (DCN-or-ICI replica groups within a slice), down to "tensor"
+innermost (fastest ICI neighborhood) — so GSPMD's collectives decompose
+hierarchically: reduce-scatter/all-gather over ICI within a slice, one
+all-reduce across slices over DCN (the pjit/TPUv4 scaling pattern,
+PAPERS.md "Scalable Training of Language Models using JAX pjit and
+TPUv4"). The slice is also the FAULT DOMAIN: elastic resume treats
+"lost a slice" as a legal rescale (ckpt/elastic.py), and
+resilience/slices.py detects a dead slice instead of letting the DCN
+all-reduce hang.
+
+Slice discovery (``slice_assignments`` / ``process_slice_context``):
+real TPU multislice exposes ``device.slice_index``; MEGASCALE_* env vars
+carry the same facts on older stacks; the ``FMS_SIM_SLICES`` env knob
+(or an explicit ``num_slices``) partitions a gloo/CPU world into
+simulated slices for tests — processes are split into ``S`` contiguous
+equal blocks.
 """
 
+import os
+import re
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh
 
+AXIS_DCN = "dcn"
 AXIS_REPLICA = "replica"
 AXIS_FSDP = "fsdp"
 AXIS_EXPERT = "expert"
 AXIS_CONTEXT = "context"
 AXIS_TENSOR = "tensor"
-MESH_AXES = (AXIS_REPLICA, AXIS_FSDP, AXIS_EXPERT, AXIS_CONTEXT, AXIS_TENSOR)
+MESH_AXES = (
+    AXIS_DCN,
+    AXIS_REPLICA,
+    AXIS_FSDP,
+    AXIS_EXPERT,
+    AXIS_CONTEXT,
+    AXIS_TENSOR,
+)
 
 # Axes a batch is sharded over (all data-parallel dimensions). The expert
 # axis is data-parallel for every dense computation; only MoE dispatch
-# reshards from it (see module docstring).
-DATA_AXES = (AXIS_REPLICA, AXIS_FSDP, AXIS_EXPERT)
+# reshards from it (see module docstring). "dcn" leads: every slice holds
+# its own batch rows, so the only cross-slice traffic is the gradient
+# all-reduce.
+DATA_AXES = (AXIS_DCN, AXIS_REPLICA, AXIS_FSDP, AXIS_EXPERT)
+
+# Gloo/CPU simulation knob (tests, docs/train_details.md "Multi-slice"):
+# the process world is split into this many contiguous equal slices.
+SIM_SLICES_ENV = "FMS_SIM_SLICES"
 
 
 @dataclass(frozen=True)
@@ -53,6 +91,9 @@ class MeshConfig:
     tensor_parallel_size: int = 1
     context_parallel_size: int = 1
     expert_parallel_size: int = 1
+    # 0 = auto-detect (device slice metadata / MEGASCALE env /
+    # FMS_SIM_SLICES); explicit values override detection.
+    num_slices: int = 0
 
     @classmethod
     def from_train_config(cls, cfg):
@@ -62,14 +103,146 @@ class MeshConfig:
             tensor_parallel_size=getattr(cfg, "tensor_parallel_size", 1),
             context_parallel_size=getattr(cfg, "context_parallel_size", 1),
             expert_parallel_size=getattr(cfg, "expert_parallel_size", 1),
+            num_slices=int(getattr(cfg, "num_slices", 0) or 0),
         )
 
 
-def _default_group_size(n_dp: int) -> int:
-    """HSDP group size when unspecified: devices per host if the world spans
-    multiple hosts (the reference shards within the 8-GPU node,
-    ref:README), else the full data-parallel extent."""
-    local = jax.local_device_count()
+# ---------------------------------------------------------------------------
+# slice discovery
+# ---------------------------------------------------------------------------
+
+
+def _env_num_slices() -> int:
+    """Slice count from the environment: the gloo simulation knob first
+    (tests drive it explicitly), then the megascale launcher's count."""
+    for var in (SIM_SLICES_ENV, "MEGASCALE_NUM_SLICES"):
+        raw = os.environ.get(var, "")
+        if raw:
+            try:
+                n = int(raw)
+            except ValueError:
+                continue
+            if n > 0:
+                return n
+    return 0
+
+
+def _process_to_slice(process_index: int, process_count: int, n_slices: int) -> int:
+    """Contiguous-block mapping for simulated slices: processes
+    [k*P/S, (k+1)*P/S) form slice k."""
+    return process_index * n_slices // max(1, process_count)
+
+
+def slice_assignments(
+    devices: Sequence, num_slices: int = 0
+) -> Tuple[List[int], int]:
+    """Per-device slice ids for ``devices`` (aligned to the sequence)
+    plus the slice count.
+
+    Precedence: real device metadata (``device.slice_index``, present on
+    TPU multislice) -> an explicit/env slice count partitioning by the
+    devices' ``process_index`` (gloo simulation; contiguous equal blocks
+    of processes) -> in-process fallback (single process exposing every
+    device: contiguous equal blocks of the device list itself) -> one
+    slice."""
+    devices = list(devices)
+    n = len(devices)
+    ids = [getattr(d, "slice_index", None) for d in devices]
+    if devices and all(i is not None for i in ids):
+        uniq = sorted(set(ids))
+        if len(uniq) > 1:
+            remap = {s: i for i, s in enumerate(uniq)}
+            return [remap[i] for i in ids], len(uniq)
+    n_slices = int(num_slices or 0) or _env_num_slices()
+    if n_slices <= 1:
+        return [0] * n, 1
+    if n % n_slices != 0:
+        raise ValueError(
+            f"{n} devices cannot split into {n_slices} equal slices"
+        )
+    procs = sorted({getattr(d, "process_index", 0) for d in devices})
+    if len(procs) > 1:
+        if len(procs) % n_slices != 0:
+            raise ValueError(
+                f"{len(procs)} processes cannot split into {n_slices} "
+                f"equal slices"
+            )
+        rank_of = {p: i for i, p in enumerate(procs)}
+        return [
+            _process_to_slice(
+                rank_of[getattr(d, "process_index", 0)], len(procs), n_slices
+            )
+            for d in devices
+        ], n_slices
+    per = n // n_slices
+    return [i // per for i in range(n)], n_slices
+
+
+def process_slice_context(cfg=None) -> Tuple[int, int]:
+    """(num_slices, this process's slice index) for the live world —
+    the host-side mirror of ``slice_assignments`` (guards tagging, the
+    SliceHealthMonitor, and the topology fingerprint all consume it
+    without holding a mesh). Single-slice worlds return (1, 0)."""
+    explicit = int(getattr(cfg, "num_slices", 0) or 0) if cfg is not None else 0
+    try:
+        local = jax.local_devices()
+    except RuntimeError:
+        local = []
+    sidx = next(
+        (
+            getattr(d, "slice_index", None)
+            for d in local
+            if getattr(d, "slice_index", None) is not None
+        ),
+        None,
+    )
+    if sidx is not None:
+        all_ids = {
+            getattr(d, "slice_index", None) for d in jax.devices()
+        }
+        all_ids.discard(None)
+        if len(all_ids) > 1:
+            uniq = sorted(all_ids)
+            return len(uniq), uniq.index(sidx)
+    n_slices = explicit or _env_num_slices()
+    if n_slices <= 1:
+        return 1, 0
+    raw = os.environ.get("MEGASCALE_SLICE_ID", "")
+    if raw and not os.environ.get(SIM_SLICES_ENV):
+        try:
+            return n_slices, int(raw)
+        except ValueError:
+            pass
+    return n_slices, _process_to_slice(
+        jax.process_index(), jax.process_count(), n_slices
+    )
+
+
+def num_mesh_slices(mesh: Mesh) -> int:
+    """Slice count a mesh was built with (its dcn-axis extent)."""
+    return int(mesh.shape.get(AXIS_DCN, 1))
+
+
+# ---------------------------------------------------------------------------
+# mesh construction
+# ---------------------------------------------------------------------------
+
+
+def _default_group_size(n_dp: int, devices: Sequence) -> int:
+    """HSDP group size when unspecified: devices per host if the
+    data-parallel extent spans multiple hosts (the reference shards
+    within the 8-GPU node, ref:README), else the full extent.
+
+    Derived from the PASSED devices, never ``jax.local_device_count()``:
+    a caller handing in a device subset (simulated/partial worlds,
+    ``dryrun_multichip``) must get group inference for THAT world, and
+    on multi-slice meshes the caller passes one slice's devices so the
+    group never straddles a DCN boundary."""
+    counts: dict = {}
+    for d in devices:
+        p = getattr(d, "process_index", 0)
+        counts[p] = counts.get(p, 0) + 1
+    local = max(counts.values()) if counts else 1
     if n_dp % local == 0 and n_dp > local:
         return local
     return n_dp
@@ -81,7 +254,17 @@ def build_mesh(
     devices: Optional[Sequence] = None,
     **overrides,
 ) -> Mesh:
-    """Build the 4-axis mesh from a MeshConfig (or kwargs)."""
+    """Build the 6-axis mesh from a MeshConfig (or kwargs).
+
+    Multi-slice worlds (slice metadata on the devices, MEGASCALE env,
+    the FMS_SIM_SLICES simulation knob, or an explicit ``num_slices``)
+    get the dcn axis = slice count, with each slice's devices filling
+    one dcn index — via ``mesh_utils.create_hybrid_device_mesh`` when
+    the devices carry real slice/coord metadata, else by stacking
+    per-slice ``create_device_mesh`` blocks. Single-slice worlds build
+    the device array exactly as the historical 5-axis mesh did and
+    reshape a leading dcn=1 axis on — device placement is bit-identical
+    (pinned by tests/test_sharding.py)."""
     if mesh_config is None:
         mesh_config = MeshConfig(**overrides)
     devices = list(devices if devices is not None else jax.devices())
@@ -97,29 +280,154 @@ def build_mesh(
         )
     n_dp = world // (tp * cp * ep)
 
+    slice_ids, n_slices = slice_assignments(
+        devices, int(mesh_config.num_slices or 0)
+    )
+    if n_dp % n_slices != 0:
+        raise ValueError(
+            f"data-parallel extent {n_dp} not divisible by the slice "
+            f"count {n_slices}; tensor/context/expert axes may not span "
+            f"slices"
+        )
+    slice_dp = n_dp // n_slices
+    per_slice = [
+        [d for d, s in zip(devices, slice_ids) if s == k]
+        for k in range(n_slices)
+    ]
+    if len({len(g) for g in per_slice}) > 1:
+        raise ValueError(
+            f"slices are unevenly sized "
+            f"({[len(g) for g in per_slice]} devices): the dcn mesh axis "
+            f"needs equal slices"
+        )
+
     strategy = mesh_config.sharding_strategy
     if strategy == "ddp":
-        replica, fsdp = n_dp, 1
+        replica, fsdp = slice_dp, 1
     elif strategy in ("fsdp", "tp"):
         # "tp" (speculator path) shards the base model over the remaining
         # devices FSDP-style alongside the tensor axis
         # (ref:speculator/train_speculator.py:133-160).
-        replica, fsdp = 1, n_dp
+        replica, fsdp = 1, slice_dp
     elif strategy == "hsdp":
-        group = mesh_config.sharding_group_size or _default_group_size(n_dp)
-        if n_dp % group != 0:
+        group = mesh_config.sharding_group_size or _default_group_size(
+            slice_dp, per_slice[0]
+        )
+        if slice_dp % group != 0:
             raise ValueError(
-                f"data-parallel extent {n_dp} not divisible by sharding group {group}"
+                f"per-slice data-parallel extent {slice_dp} not divisible "
+                f"by sharding group {group}"
             )
-        replica, fsdp = n_dp // group, group
+        replica, fsdp = slice_dp // group, group
     else:
         raise ValueError(f"unknown sharding strategy: {strategy}")
 
-    shape = (replica, fsdp, ep, cp, tp)
-    device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    shape5 = (replica, fsdp, ep, cp, tp)
+    if n_slices == 1:
+        # bit-identical to the historical 5-axis construction: same
+        # create_device_mesh call, a leading size-1 dcn axis reshaped on
+        device_array = mesh_utils.create_device_mesh(shape5, devices=devices)
+        device_array = device_array.reshape((1,) + device_array.shape)
+        return Mesh(device_array, MESH_AXES)
+
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        # real multislice hardware: let jax place the per-slice mesh by
+        # ICI topology and replicate the layout across slices
+        try:
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                (1,) + shape5,
+                (n_slices, 1, 1, 1, 1, 1),
+                devices=devices,
+            )
+            return Mesh(device_array, MESH_AXES)
+        except (ValueError, NotImplementedError, AssertionError):
+            pass  # fall through to the generic per-slice stacking
+    device_array = np.stack(
+        [
+            mesh_utils.create_device_mesh(shape5, devices=group)
+            for group in per_slice
+        ]
+    )
     return Mesh(device_array, MESH_AXES)
 
 
 def data_parallel_extent(mesh: Mesh) -> int:
     """Number of ways the global batch is split (product of DATA_AXES)."""
     return int(np.prod([mesh.shape[a] for a in DATA_AXES]))
+
+
+# ---------------------------------------------------------------------------
+# HLO collective attribution (bench + tests)
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+_GROUPS_LIST_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?"
+)
+
+
+def _parse_replica_groups(attr_text: str):
+    """Decode the two HLO replica_groups encodings into device-id lists:
+    the explicit ``{{0,1},{2,3}}`` form and the iota-v2
+    ``[g,s]<=[dims]T(perm)`` form."""
+    m = _GROUPS_LIST_RE.search(attr_text)
+    if m:
+        return [
+            [int(x) for x in grp.split(",") if x.strip()]
+            for grp in re.findall(r"\{([^{}]*)\}", m.group(1))
+        ]
+    m = _GROUPS_IOTA_RE.search(attr_text)
+    if m:
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(x) for x in m.group(3).split(",")]
+        arr = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            arr = arr.transpose([int(x) for x in m.group(4).split(",")])
+        return arr.reshape(n_groups, group_size).tolist()
+    return None
+
+
+def hlo_collective_split(hlo_text: str, mesh: Mesh) -> dict:
+    """Classify every collective in compiled-HLO text as ICI
+    (within one slice) or DCN (replica groups spanning slices).
+
+    The attribution behind the MULTICHIP bench rows and the
+    "dcn=1 adds no cross-slice collectives" test pin: replica_groups in
+    compiled SPMD HLO hold LOGICAL partition ordinals — positions in the
+    computation's device assignment, i.e. the mesh's flattened device
+    order — NOT hardware device ids (they coincide on CPU test backends
+    but not on real multislice hardware, where create_hybrid_device_mesh
+    orders devices by topology). The dcn axis is the mesh's leading
+    axis, so flattened order is slice-major: ordinal // per_slice is the
+    slice. A collective whose any replica group contains two slices'
+    ordinals is DCN traffic."""
+    n_slices = int(mesh.shape.get(AXIS_DCN, 1))
+    per_slice = max(1, mesh.size // max(1, n_slices))
+    slice_of = {i: i // per_slice for i in range(mesh.size)}
+    counts = {"ici": 0, "dcn": 0, "unattributed": 0}
+    op_re = re.compile(
+        r"\b(" + "|".join(_COLLECTIVE_OPS) + r")(-start)?(\.\d+)?\("
+    )
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if not op_re.search(stripped):
+            continue
+        if "-done" in stripped:
+            continue  # count each async collective once (its -start)
+        groups = _parse_replica_groups(stripped)
+        if groups is None:
+            counts["unattributed"] += 1
+            continue
+        crosses = any(
+            len({slice_of.get(i, -1) for i in g}) > 1 for g in groups
+        )
+        counts["dcn" if crosses else "ici"] += 1
+    return counts

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fms_fsdp_tpu.parallel.mesh import (
     AXIS_CONTEXT,
+    AXIS_DCN,
     AXIS_FSDP,
     AXIS_REPLICA,
     AXIS_TENSOR,
@@ -32,9 +33,33 @@ from fms_fsdp_tpu.parallel.mesh import (
 
 
 def batch_pspec() -> P:
-    """Spec for (B, S) token batches: batch over all data axes, sequence over
-    the context axis (ring attention); replicated over tensor."""
+    """Spec for (B, S) token batches: batch over all data axes (dcn
+    included — each slice holds its own rows), sequence over the context
+    axis (ring attention); replicated over tensor."""
     return P(DATA_AXES, AXIS_CONTEXT)
+
+
+def hierarchical_reduce_info(mesh: Mesh) -> Dict[str, tuple]:
+    """Name the two transport tiers the gradient reduce decomposes over
+    on this mesh (docs/train_details.md "Multi-slice").
+
+    Param specs never mention the ``dcn`` axis, so params replicate
+    across slices; the batch is sharded over DATA_AXES (dcn included),
+    so GSPMD lowers the backward's gradient reduction hierarchically —
+    reduce-scatter/all-gather over the within-slice ICI axes, plus ONE
+    all-reduce across slices over the dcn axis. ``dcn_axes`` is empty on
+    single-slice meshes: a size-1 axis generates no collective, keeping
+    the traced step bit-identical to the pre-dcn program (pinned by
+    tests/test_sharding.py). The quantized-reduce wire
+    (``quantized_grad_reduce``) sits at exactly this boundary — on
+    multi-slice meshes the round-trip models the DCN hop, which is where
+    the bandwidth lever pays most (PAPERS.md "Memory and Bandwidth are
+    All You Need for Fully Sharded Data Parallel")."""
+    ici = tuple(
+        a for a in DATA_AXES if a != AXIS_DCN and mesh.shape[a] > 1
+    )
+    dcn = (AXIS_DCN,) if mesh.shape[AXIS_DCN] > 1 else ()
+    return {"ici_axes": ici, "dcn_axes": dcn}
 
 
 def embed_lookup(table, tokens, mesh: Optional[Mesh]):
@@ -88,14 +113,24 @@ def llama_param_specs(scan: bool = True) -> Dict[str, Any]:
 
 
 def resolve_spec(spec: P, shape, mesh: Mesh) -> P:
-    """Drop spec entries whose mesh extent does not divide the dim size."""
+    """Drop spec entries whose mesh extent does not divide the dim size.
+
+    Axes the mesh does not carry are dropped from the entry first (a
+    5-axis legacy mesh — or any future submesh — consumes the shared
+    dcn-bearing specs without a KeyError; a dropped axis is exactly a
+    size-1 axis sharding-wise)."""
     out = []
     for i, entry in enumerate(spec):
         if entry is None:
             out.append(None)
             continue
         axes = entry if isinstance(entry, tuple) else (entry,)
-        extent = int(np.prod([mesh.shape[a] for a in axes]))
+        present = tuple(a for a in axes if a in mesh.shape)
+        if not present:
+            out.append(None)
+            continue
+        entry = present if isinstance(entry, tuple) else present[0]
+        extent = int(np.prod([mesh.shape[a] for a in present]))
         if i < len(shape) and shape[i] % extent == 0:
             out.append(entry)
         else:
@@ -224,6 +259,13 @@ def quantized_grad_reduce(grads, mode: str, quant_state=None):
     wire dtype, the actual bandwidth win) must re-pin the parity
     tolerances against that per-shard formulation; docs/performance.md
     "Quantized training" states the contract and this limit.
+
+    Multi-slice composition (``hierarchical_reduce_info``): on a mesh
+    with a dcn axis > 1 the reduce boundary this round-trip models is
+    the cross-slice DCN all-reduce — the narrowest link in the
+    hierarchy, so the wire format's byte savings land where they pay
+    most. The single-draw contract above is unchanged: per-slice
+    partials over ICI stay full-precision in this model.
     """
     from fms_fsdp_tpu.ops.quant import (
         delayed_scale,

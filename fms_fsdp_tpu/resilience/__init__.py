@@ -11,6 +11,10 @@ non-finite batch. The preemption half lives in
   (``tests/test_resilience.py``);
 - ``guards``    — host-side anomaly accounting over the in-jit
   non-finite flag (skip/report/abort) and a wall-clock step watchdog;
+- ``slices``    — multi-slice fault domains: per-slice liveness
+  heartbeats + the DCN-collective timeout classifier, so a dead slice
+  is reported as "slice K lost, restart at world minus one fault
+  domain" instead of a hang (docs/resilience.md "Slice fault domains");
 - ``retry``     — bounded retry-with-backoff helpers and the retrying
   shard-file handler wrapper;
 - ``integrity`` — per-checkpoint manifests (file list + sizes +
@@ -32,10 +36,12 @@ from fms_fsdp_tpu.resilience.integrity import (
     write_manifest,
 )
 from fms_fsdp_tpu.resilience.retry import RetryingShardHandler, retry_call
+from fms_fsdp_tpu.resilience.slices import SliceHealthMonitor
 
 __all__ = [
     "AnomalyGuard",
     "RetryingShardHandler",
+    "SliceHealthMonitor",
     "StepWatchdog",
     "configure_faults",
     "fault_params",

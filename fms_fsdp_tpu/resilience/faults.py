@@ -30,6 +30,19 @@ ckpt_precommit_kill
                 (fully written dir) and the metadata.json commit marker
                 (hard-exits the process with ``code``, default 1) — the
                 mid-save kill whose torn dir resume must skip
+slice_kill      the train loop's step boundary, before the step is
+                dispatched (hard-exits the process with ``code``,
+                default 1). Filtered by ``slice``/``step``, it kills
+                every process of one fault domain at once — the
+                whole-slice preemption the SliceHealthMonitor must
+                detect and the surviving slices must classify
+                (resilience/slices.py)
+dcn_reduce_stall
+                the same step boundary (parks the rank in a
+                ``seconds``-long sleep, default 3600) — the wedged
+                cross-slice reduce whose hang the slice/step watchdogs
+                must convert into an actionable report instead of a
+                burned reservation
 ==============  =======================================================
 
 Spec strings configure the registry, via the ``FMS_FAULTS`` environment
@@ -40,7 +53,7 @@ variable or ``TrainConfig.faults``::
 
 Filter params are matched against the call-site context before firing:
 ``path`` / ``op`` / ``tier`` (substring), ``worker`` / ``batch`` /
-``step`` (equality). A configured filter the call site does not supply in its
+``step`` / ``slice`` (equality). A configured filter the call site does not supply in its
 context is a non-match (the fault does not fire) — a typo'd filter must
 never degrade into firing everywhere.
 ``times=N`` caps the number of fires (per process; counters are
@@ -62,7 +75,7 @@ _FIRED: Dict[str, int] = {}
 ENV_VAR = "FMS_FAULTS"
 
 # params that filter whether a call-site context matches (vs payload)
-_FILTER_KEYS = ("path", "op", "worker", "batch", "step", "tier")
+_FILTER_KEYS = ("path", "op", "worker", "batch", "step", "tier", "slice")
 
 
 def _parse_value(v: str):

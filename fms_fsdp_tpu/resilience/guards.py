@@ -83,7 +83,10 @@ class StepWatchdog:
     passed in by the trainer at construction — the stall path must not
     import or call into jax from the watchdog thread of a wedged
     process — so merged multi-host logs attribute WHICH host's stacks
-    are being read.
+    are being read. ``slice_index`` (optional, multi-slice meshes)
+    additionally names the host's fault domain, so a multi-slice stall
+    triage reads "[proc N slice K]" and goes straight to the slice
+    (docs/resilience.md "Slice fault domains").
     """
 
     EXIT_CODE = 2
@@ -94,17 +97,22 @@ class StepWatchdog:
         poll_s: float = None,
         heartbeat_path=None,
         process_index=None,
+        slice_index=None,
     ):
         assert timeout_s > 0
         self.timeout_s = timeout_s
         self.poll_s = min(1.0, timeout_s / 4) if poll_s is None else poll_s
         self.heartbeat_path = heartbeat_path
         self.process_index = process_index
-        self._tag = (
-            "step watchdog"
-            if process_index is None
-            else f"step watchdog [proc {process_index}]"
-        )
+        self.slice_index = slice_index
+        if process_index is None:
+            self._tag = "step watchdog"
+        elif slice_index is None:
+            self._tag = f"step watchdog [proc {process_index}]"
+        else:
+            self._tag = (
+                f"step watchdog [proc {process_index} slice {slice_index}]"
+            )
         self._last_beat = time.monotonic()
         self._paused = 0
         self._stop = threading.Event()

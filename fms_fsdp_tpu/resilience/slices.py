@@ -1,0 +1,271 @@
+"""Slice fault domains: per-slice liveness aggregation and the
+DCN-collective timeout classifier.
+
+On a multi-slice mesh (parallel/mesh.py: the ``dcn`` axis) the slice is
+the unit capacity dies in — a preempted or crashed slice takes its whole
+ICI domain with it. To every *surviving* host the failure looks like a
+cross-slice collective (the DCN gradient all-reduce, or the report-time
+metric fetch that drains it) which simply never completes: without this
+module the run either hangs until the scheduler's job timeout or dies in
+an opaque transport error, and the operator cannot tell a dead slice
+from a wedged step (the StepWatchdog's generic stall).
+
+``SliceHealthMonitor`` closes that gap with out-of-band liveness:
+
+- every process writes a tiny heartbeat file
+  (``slice<k>_proc<r>.hb``) into a SHARED directory from a daemon
+  thread, so the file keeps updating while the main thread is parked
+  inside a blocked collective — the heartbeat tracks *process
+  liveness* (the fault-domain signal), not step progress;
+- the same thread scans every peer's file. Staleness is judged by
+  "mtime unchanged across local polls for > timeout_s" (the same
+  skew-immune discipline as the checkpoint GC quiesce window — shared
+  -storage server clocks can lead or lag this host's);
+- when every process of some OTHER slice has gone silent, the slice is
+  declared LOST: the monitor prints one actionable line on every
+  healthy host — naming the dead slice, its last observed step, and
+  the restart policy ("restart at world minus one fault domain"; the
+  elastic-resume path preserves the global batch and reshards the
+  loader walk, docs/checkpointing.md) — and fail-fasts the process
+  (``os._exit``) so the scheduler restarts the world instead of
+  burning the reservation on a DCN hang.
+
+The *classifier* half (``wait_classify``): gloo/TCP simulations (and
+some real transports) surface a dead peer as an exception in the
+collective rather than a hang. The train loop routes such exceptions
+through ``wait_classify``, which waits up to the timeout for the
+liveness verdict and lets the loop re-raise a classified
+"slice K lost" error instead of the raw transport traceback — the same
+message whichever way the failure surfaced.
+
+Fault sites (resilience/faults.py): ``slice_kill`` hard-exits every
+process of one slice at a chosen step and ``dcn_reduce_stall`` parks a
+rank at the reduce boundary, so the whole detect-classify-resume path is
+CPU-testable (tests/test_resilience.py, tests/test_elastic.py).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+EXIT_CODE = 3
+
+_HB_SUFFIX = ".hb"
+
+
+def _hb_name(slice_index: int, process_index: int) -> str:
+    return f"slice{slice_index}_proc{process_index}{_HB_SUFFIX}"
+
+
+def _parse_hb_name(name: str):
+    if not name.endswith(_HB_SUFFIX) or not name.startswith("slice"):
+        return None
+    try:
+        s, p = name[len("slice") : -len(_HB_SUFFIX)].split("_proc")
+        return int(s), int(p)
+    except ValueError:
+        return None
+
+
+class SliceHealthMonitor:
+    """Per-slice liveness over a shared heartbeat directory.
+
+    ``beat(step)`` is called once per loop iteration (stores the step
+    for the post-mortem message; the liveness file itself is written by
+    the monitor thread, so a blocked main thread keeps beating liveness
+    but not progress). ``on_dead`` (tests) replaces the default
+    report-and-``os._exit`` action.
+    """
+
+    EXIT_CODE = EXIT_CODE
+
+    def __init__(
+        self,
+        heartbeat_dir: str,
+        num_slices: int,
+        slice_index: int,
+        process_index: int,
+        timeout_s: float,
+        poll_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        on_dead: Optional[Callable[[str], None]] = None,
+    ):
+        assert timeout_s > 0 and num_slices > 1
+        self.dir = heartbeat_dir
+        self.num_slices = int(num_slices)
+        self.slice_index = int(slice_index)
+        self.process_index = int(process_index)
+        self.timeout_s = float(timeout_s)
+        self.poll_s = (
+            min(1.0, self.timeout_s / 4) if poll_s is None else float(poll_s)
+        )
+        self._clock = clock
+        self._on_dead = on_dead
+        self._tag = (
+            f"slice-health [proc {self.process_index} "
+            f"slice {self.slice_index}]"
+        )
+        self._step = 0
+        self._last_progress = clock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # path -> (mtime fingerprint, local clock when first seen at it):
+        # staleness is "unchanged across local polls", never a wall-clock
+        # age comparison against a possibly-skewed storage server
+        self._marks: Dict[str, tuple] = {}
+        self._dead: Optional[dict] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "SliceHealthMonitor":
+        os.makedirs(self.dir, exist_ok=True)
+        self._write_own()
+        self._thread = threading.Thread(
+            target=self._run, name="slice-health", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def beat(self, step: int) -> None:
+        self._step = int(step)
+        self._last_progress = self._clock()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- liveness file -----------------------------------------------------
+
+    def _write_own(self) -> None:
+        path = os.path.join(
+            self.dir, _hb_name(self.slice_index, self.process_index)
+        )
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "slice": self.slice_index,
+                        "proc": self.process_index,
+                        "step": self._step,
+                        "time_unix": time.time(),
+                    },
+                    f,
+                )
+            os.replace(tmp, path)
+        except OSError:
+            pass  # a transient shared-fs hiccup must not kill the writer
+
+    # -- scanning ----------------------------------------------------------
+
+    def _scan(self) -> Optional[dict]:
+        """One liveness pass. Returns {"slice", "procs", "last_step",
+        "silent_s"} for a lost slice, else None. Pure over the
+        injectable clock (fake-clock testable)."""
+        now = self._clock()
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return None
+        by_slice: Dict[int, list] = {}
+        for name in names:
+            parsed = _parse_hb_name(name)
+            if parsed is None:
+                continue
+            s, p = parsed
+            path = os.path.join(self.dir, name)
+            try:
+                m = os.path.getmtime(path)
+            except OSError:
+                continue
+            marked = self._marks.get(path)
+            if marked is None or marked[0] != m:
+                self._marks[path] = (m, now)
+                age = 0.0
+            else:
+                age = now - marked[1]
+            by_slice.setdefault(s, []).append((p, path, age))
+        for s, entries in sorted(by_slice.items()):
+            if s == self.slice_index or not entries:
+                continue
+            if all(age > self.timeout_s for _, _, age in entries):
+                last_step = -1
+                for _, path, _ in entries:
+                    try:
+                        with open(path) as f:
+                            last_step = max(
+                                last_step, int(json.load(f).get("step", -1))
+                            )
+                    except (OSError, ValueError):
+                        pass
+                return {
+                    "slice": s,
+                    "procs": sorted(p for p, _, _ in entries),
+                    "last_step": last_step,
+                    "silent_s": min(age for _, _, age in entries),
+                }
+        return None
+
+    def describe_loss(self, dead: dict) -> str:
+        """The one actionable line every healthy host prints."""
+        blocked = self._clock() - self._last_progress
+        stall = (
+            f"; the local step has been blocked in a cross-slice "
+            f"collective for {blocked:.0f}s — classified as slice loss, "
+            f"not a local stall"
+            if blocked > self.poll_s * 2
+            else ""
+        )
+        step = dead.get("last_step", -1)
+        at = f" (last progress at step {step})" if step >= 0 else ""
+        return (
+            f"{self._tag}: slice {dead['slice']} lost — all "
+            f"{len(dead['procs'])} of its process(es) "
+            f"{dead['procs']} silent for {dead['silent_s']:.0f}s{at}{stall}. "
+            f"Restart at world minus one fault domain "
+            f"({self.num_slices} -> {self.num_slices - 1} slice(s), same "
+            f"per-slice shape): elastic resume restores the last committed "
+            f"checkpoint, preserves the global batch, and reshards the "
+            f"loader walk (docs/resilience.md, docs/checkpointing.md)."
+        )
+
+    # -- classifier --------------------------------------------------------
+
+    def wait_classify(self, extra_wait_s: Optional[float] = None) -> Optional[dict]:
+        """Block up to ``timeout_s + extra_wait_s`` waiting for a
+        lost-slice verdict — the classifier for a cross-slice collective
+        that ERRORED (dead-peer transport reset) rather than hung: the
+        peer's files need a full timeout window to go stale, so the
+        caller holding a transport exception waits here before deciding
+        whether it is a slice loss or an unrelated failure."""
+        deadline = self._clock() + self.timeout_s + (
+            self.poll_s * 2 if extra_wait_s is None else extra_wait_s
+        )
+        while True:
+            dead = self._dead or self._scan()
+            if dead is not None or self._clock() >= deadline:
+                return dead
+            if self._stop.wait(self.poll_s):
+                return self._dead
+
+    # -- thread ------------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self._write_own()
+            dead = self._scan()
+            if dead is None:
+                continue
+            self._dead = dead
+            msg = self.describe_loss(dead)
+            if self._on_dead is not None:
+                self._on_dead(msg)
+                return
+            sys.stderr.write(msg + "\n")
+            sys.stderr.flush()
+            # fail-fast on every healthy host: parking the world in the
+            # dead slice's DCN collective burns the reservation and
+            # yields no post-mortem
+            os._exit(self.EXIT_CODE)

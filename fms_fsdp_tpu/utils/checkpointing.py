@@ -260,6 +260,14 @@ class Checkpointer:
                 f"compatibility check."
             )
             return
+        if "num_slices" not in topo and "num_slices" in self.fingerprint:
+            # v1 fingerprint from pre-multi-slice code: the slice
+            # fault-domain checks have nothing to compare against
+            self.report(
+                f"Note: checkpoint {load_path} predates slice-aware "
+                f"topology fingerprints (no slice fields); slice "
+                f"fault-domain checks are skipped for this resume."
+            )
         problems, changed = check_rescale(
             topo,
             self.fingerprint,

@@ -85,11 +85,40 @@ def setup():
             )
         else:
             jax.distributed.initialize()
+    # slice-aware init (docs/train_details.md "Multi-slice"): surface
+    # the detected fault domain once the world is up — slice index/count
+    # come from device attributes on real multislice hardware, the
+    # MEGASCALE env on older stacks, or the FMS_SIM_SLICES gloo
+    # simulation knob in tests (parallel/mesh.py). Purely informational
+    # here; the mesh builder and train loop re-derive the same facts.
+    try:
+        from fms_fsdp_tpu.parallel.mesh import process_slice_context
+
+        n_slices, slice_idx = process_slice_context()
+        if n_slices > 1:
+            print(
+                f"--> multi-slice world: slice {slice_idx} of {n_slices} "
+                f"(process {jax.process_index()} of {jax.process_count()})"
+            )
+    except Exception:  # noqa: BLE001 — a detection hiccup must not block init
+        pass
 
 
 def setup_environ_flags():
     """Fail-loudly flags (ref:train_utils.py:187-189 analog)."""
     os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+
+
+class DeliberateAbort(RuntimeError):
+    """An abort the train loop raised ON PURPOSE (anomaly guard).
+
+    The multi-slice exception classifier must not hold these for a
+    liveness verdict: a whole-world deliberate abort would otherwise
+    wait out slice_timeout_s on every rank and — with the other slice's
+    processes already gone — be re-reported as a lost slice, sending the
+    operator to a fault-domain restart for what is really a data/NaN
+    problem. (Transport errors from a genuinely dead slice arrive as
+    XlaRuntimeError/etc., never as this type.)"""
 
 
 def get_tracker(cfg, rank: int):
@@ -330,7 +359,10 @@ def _train_loop(
     world_size,
     dataloader=None,
 ):
+    from fms_fsdp_tpu.parallel.mesh import process_slice_context
+    from fms_fsdp_tpu.resilience.faults import fire_fault
     from fms_fsdp_tpu.resilience.guards import AnomalyGuard, StepWatchdog
+    from fms_fsdp_tpu.resilience.slices import SliceHealthMonitor
     from fms_fsdp_tpu.train.step import wrap_step_fn
 
     window = []
@@ -343,16 +375,36 @@ def _train_loop(
     guard = AnomalyGuard(
         max_consecutive=max(1, getattr(cfg, "anomaly_max_consecutive", 8))
     )
+    # multi-slice fault domains (docs/resilience.md): slice context for
+    # guard tagging + the slice health monitor; (1, 0) on single-slice
+    # worlds, where every slice-aware path below is inert
+    n_slices, slice_idx = process_slice_context(cfg)
+    slice_tag = f"[proc {rank} slice {slice_idx}] " if n_slices > 1 else ""
     watchdog = None
     timeout_s = float(getattr(cfg, "step_timeout_s", 0.0) or 0.0)
     if timeout_s > 0:
         hb = observer.heartbeat.path if observer.heartbeat else None
         # rank (== jax.process_index() in the entries) is passed in so a
         # multi-host stall report names its host without the wedged
-        # process having to touch jax from the watchdog thread
+        # process having to touch jax from the watchdog thread; the
+        # slice index rides along on multi-slice worlds so stall triage
+        # names the fault domain directly
         watchdog = StepWatchdog(
-            timeout_s, heartbeat_path=hb, process_index=rank
+            timeout_s,
+            heartbeat_path=hb,
+            process_index=rank,
+            slice_index=slice_idx if n_slices > 1 else None,
         ).start()
+    monitor = None
+    if n_slices > 1:
+        hb_dir = str(getattr(cfg, "slice_heartbeat_dir", "") or "")
+        if not hb_dir and getattr(cfg, "obs_dir", ""):
+            hb_dir = os.path.join(cfg.obs_dir, "slice_health")
+        slice_timeout = float(getattr(cfg, "slice_timeout_s", 0.0) or 0.0)
+        if hb_dir and slice_timeout > 0:
+            monitor = SliceHealthMonitor(
+                hb_dir, n_slices, slice_idx, rank, slice_timeout
+            ).start()
 
     # phase instrumentation: data_wait at the loop's next(), compute at
     # step dispatch + the report-time fetch, checkpoint inside save()
@@ -507,6 +559,20 @@ def _train_loop(
                 break
             if watchdog:
                 watchdog.beat()
+            if monitor:
+                monitor.beat(batch_idx)
+            # slice-scoped fault sites (resilience/faults.py): kill every
+            # process of one fault domain at the step boundary, or park a
+            # rank in a wedged cross-slice reduce — the failures the
+            # SliceHealthMonitor must detect/classify
+            kill = fire_fault("slice_kill", step=batch_idx, slice=slice_idx)
+            if kill is not None:
+                os._exit(int(kill.get("code", 1)))
+            stall = fire_fault(
+                "dcn_reduce_stall", step=batch_idx, slice=slice_idx
+            )
+            if stall is not None:
+                time.sleep(float(stall.get("seconds", 3600)))
             state, metrics = step_fn(state, batch)
             window.append(metrics)
 
@@ -530,9 +596,9 @@ def _train_loop(
                             tokens_seen=global_tokens(batch_idx),
                             skipped_steps=guard.skipped_batches,
                         )
-                    raise RuntimeError(
-                        f"anomaly guard: {guard.consecutive} consecutive "
-                        f"non-finite steps (threshold "
+                    raise DeliberateAbort(
+                        f"{slice_tag}anomaly guard: {guard.consecutive} "
+                        f"consecutive non-finite steps (threshold "
                         f"{guard.max_consecutive}); checkpoint saved at "
                         f"step {batch_idx}, aborting"
                     )
@@ -586,11 +652,28 @@ def _train_loop(
         flush_window(batch_idx, drain=True)
         if guard.should_abort() and rank == 0:
             print(
-                f"WARNING: run exited with {guard.consecutive} "
+                f"WARNING: {slice_tag}run exited with {guard.consecutive} "
                 f"consecutive non-finite steps still streaking"
             )
+    except Exception as e:
+        # DCN-collective timeout classifier (resilience/slices.py): a
+        # dead slice can surface on the survivors as a transport ERROR
+        # from the cross-slice collective rather than a hang. Hold the
+        # exception until the liveness verdict is in, and re-raise it
+        # classified — "slice K lost, restart at world minus one fault
+        # domain" — instead of the raw transport traceback. Unrelated
+        # failures (no slice went silent) re-raise untouched, and the
+        # loop's own deliberate aborts skip the wait entirely (a
+        # whole-world abort must not be re-badged as a slice loss).
+        if monitor is not None and not isinstance(e, DeliberateAbort):
+            dead = monitor.wait_classify()
+            if dead is not None:
+                raise RuntimeError(monitor.describe_loss(dead)) from e
+        raise
     finally:
         if watchdog:
             watchdog.stop()
+        if monitor:
+            monitor.stop()
 
     return train_loss

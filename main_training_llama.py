@@ -150,6 +150,15 @@ def main(**kwargs):
     from fms_fsdp_tpu.obs import build_observer
 
     observer = build_observer(cfg, rank, model_cfg=model_cfg)
+    # multi-slice collective split (schema v5): the report-cadence probe
+    # times one within-slice (ICI) and one cross-slice (DCN) reduce per
+    # window so cross-slice overhead is attributable; None (and zero
+    # cost) on single-slice meshes
+    from fms_fsdp_tpu.obs.collectives import make_collective_split_probe
+
+    observer.attach_collective_probe(
+        make_collective_split_probe(mesh, observer.timer)
+    )
 
     # batch loop: stack per-rank batches to the local device batch
     feed = DeviceFeed(

@@ -52,7 +52,10 @@ _USED_TOPOLOGY = None  # recorded per target into AOT_LOWER.json
 
 
 def _topology_mesh(shape=(1, 1, 1, 1, 1), topology=None):
-    """5-axis Mesh over the deviceless v5e topology's devices. The
+    """Full-axis Mesh over the deviceless v5e topology's devices
+    (legacy 5-axis shapes get a leading dcn=1 prepended — AOT targets
+    are single-slice programs; the dcn axis only matters on multislice
+    hardware the deviceless topologies cannot describe). The
     default is a SINGLE-device mesh: an un-shard_mapped Mosaic kernel
     cannot be partitioned by GSPMD, so standalone-kernel targets compile
     single-chip (the bench-row configuration) while multi-device shapes
@@ -68,6 +71,8 @@ def _topology_mesh(shape=(1, 1, 1, 1, 1), topology=None):
 
     from fms_fsdp_tpu.parallel.mesh import MESH_AXES
 
+    if len(shape) == len(MESH_AXES) - 1:
+        shape = (1,) + tuple(shape)
     n = int(np.prod(shape))
     name = topology or TOPOLOGY
     td = topologies.get_topology_desc(platform="tpu", topology_name=name)

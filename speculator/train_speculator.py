@@ -162,8 +162,15 @@ def main(**kwargs):
     # pretraining entries (docs/observability.md); MFU is null — the
     # run's FLOPs are dominated by the frozen base, not the speculator
     from fms_fsdp_tpu.obs import build_observer
+    from fms_fsdp_tpu.obs.collectives import make_collective_split_probe
 
     observer = build_observer(cfg, rank)
+    # multi-slice collective split (schema v5): None / zero cost on the
+    # usual single-slice speculator mesh, same wiring as the pretraining
+    # entries
+    observer.attach_collective_probe(
+        make_collective_split_probe(mesh, observer.timer)
+    )
     feed = DeviceFeed(
         rebatch(train_loader, local_batch, cfg.batch_size),
         mesh,

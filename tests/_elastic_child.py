@@ -18,7 +18,11 @@ xla_force_host_platform_device_count=4, COORDINATOR_ADDRESS,
 NUM_PROCESSES, PROCESS_ID. argv: ckpt_dir data_path walk_dir phase
 num_steps ckpt_interval [faults] [key=value overrides...] — overrides
 are extra TrainConfig fields (e.g. quantized_reduce=fp8_delayed for the
-amax-state elastic round-trip test).
+amax-state elastic round-trip test, or num_slices=2 +
+slice_heartbeat_dir/slice_timeout_s for the multi-slice fault-domain
+e2e; the child prints SLICE_CTX and attaches the obs collective-split
+probe exactly like main_training_llama so a multi-slice run's
+metrics.jsonl carries real ici/dcn_collective_s).
 
 The orchestration mirrors main_training_llama.main (checkpoint manager
 BEFORE the loader, resume_topology -> elastic_batch_size ->
@@ -118,6 +122,11 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
         file_type="arrow",
         logical_shards=8,
         num_workers=1,
+        # keep the reservoir small relative to the marked corpus: the
+        # default 10000-row window pulls ~2 epochs of the tiny test
+        # corpus just filling itself, and the resulting (legitimate)
+        # epoch-2 re-serves would read as replays in the walk checks
+        loader_shuffle_window=64,
         seq_length=64,
         vocab_size=2048,
         batch_size=2,
@@ -142,6 +151,10 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
 
     mesh = build_mesh(MeshConfig.from_train_config(cfg))
     data_extent = data_parallel_extent(mesh)
+    from fms_fsdp_tpu.parallel.mesh import process_slice_context
+
+    n_slices, slice_idx = process_slice_context(cfg)
+    print("SLICE_CTX", n_slices, slice_idx, flush=True)
 
     model_cfg = get_model_config("llama2_7b")
     update_config(
@@ -206,6 +219,16 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
         )
         walk_path = os.path.join(walk_dir, f"walk_{phase}_rank{rank}.txt")
         os.makedirs(walk_dir, exist_ok=True)
+        # same observer wiring as main_training_llama: the multi-slice
+        # collective-split probe attaches on EVERY rank (its reductions
+        # are collective); None / no-op on single-slice meshes
+        from fms_fsdp_tpu.obs import build_observer
+        from fms_fsdp_tpu.obs.collectives import make_collective_split_probe
+
+        observer = build_observer(cfg, rank, model_cfg=model_cfg)
+        observer.attach_collective_probe(
+            make_collective_split_probe(mesh, observer.timer)
+        )
         train(
             cfg,
             state,
@@ -218,6 +241,7 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
             tokens_seen,
             dataloader=loader,
             model_cfg=model_cfg,
+            observer=observer,
         )
     print("ELASTIC_CHILD_DONE", flush=True)
 

@@ -50,5 +50,6 @@ def test_device_feed(prefetch):
         x, y = next(it)
         assert isinstance(x, jax.Array)
         assert x.shape == (8, 8)
-        # batch dim sharded over the data axes
-        assert x.sharding.spec[0] == ("replica", "fsdp", "expert")
+        # batch dim sharded over the data axes (dcn included: each
+        # slice holds its own rows on multi-slice meshes)
+        assert x.sharding.spec[0] == ("dcn", "replica", "fsdp", "expert")

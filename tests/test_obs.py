@@ -786,3 +786,40 @@ def test_e2e_observer_absent_obs_dir_writes_nothing(tmp_path, capsys):
         if f in ("metrics.jsonl", "metrics.csv", "heartbeat.json")
     ]
     assert found == []
+
+
+def test_v5_collective_split_defaults_zero():
+    """schema v5: single-slice runs (no probe attached) report 0.0 for
+    both collective-split fields — and the record still validates."""
+    rec = _observer_record()
+    assert rec["ici_collective_s"] == 0.0
+    assert rec["dcn_collective_s"] == 0.0
+    assert validate_record(rec) == []
+
+
+def test_collective_probe_fills_v5_split():
+    """On a multi-slice mesh the report-cadence probe (obs/collectives)
+    times a real within-slice and a real cross-slice reduction into the
+    v5 fields; on a single-slice mesh no probe exists at all."""
+    from fms_fsdp_tpu.obs.collectives import make_collective_split_probe
+    from fms_fsdp_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    single = build_mesh(MeshConfig(sharding_strategy="fsdp"))
+    obs = Observer(strict_schema=True)
+    assert make_collective_split_probe(single, obs.timer) is None
+
+    mesh = build_mesh(MeshConfig(sharding_strategy="fsdp", num_slices=2))
+    probe = make_collective_split_probe(mesh, obs.timer)
+    assert probe is not None
+    obs.attach_collective_probe(probe)
+    rec = obs.report(
+        10,
+        4,
+        loss=2.5,
+        tokens_per_sec_per_chip=1000.0,
+        skipped_steps_total=0,
+        skipped_steps_window=0,
+    )
+    assert rec["ici_collective_s"] > 0.0, rec
+    assert rec["dcn_collective_s"] > 0.0, rec
+    assert validate_record(rec) == []

@@ -692,3 +692,169 @@ def test_watchdog_quiet_when_fed():
         w.beat()
         time.sleep(0.1)
     w.stop()  # still alive: beats kept it quiet
+
+
+# ---- slice fault domains (multi-slice DCN meshes) --------------------------
+
+
+def test_slice_fault_site_filters():
+    """The slice filter key matches like step/worker: the fault fires
+    only for the configured fault domain, and never when the call site
+    cannot supply a slice."""
+    configure_faults("slice_kill:slice=1:step=6")
+    assert fire_fault("slice_kill", step=6, slice=0) is None
+    assert fire_fault("slice_kill", step=5, slice=1) is None
+    assert fire_fault("slice_kill", step=6) is None  # no slice in ctx
+    params = fire_fault("slice_kill", step=6, slice=1)
+    assert params is not None
+    configure_faults("dcn_reduce_stall:slice=0:seconds=7")
+    params = fire_fault("dcn_reduce_stall", step=3, slice=0)
+    assert params is not None and params["seconds"] == 7
+
+
+def test_watchdog_tag_names_slice():
+    """Satellite: multi-slice stall reports carry the fault domain
+    alongside the PR 5 [proc N] prefix."""
+    from fms_fsdp_tpu.resilience.guards import StepWatchdog
+
+    w = StepWatchdog(5, process_index=3, slice_index=1)
+    assert w._tag == "step watchdog [proc 3 slice 1]"
+    w = StepWatchdog(5, process_index=3)
+    assert w._tag == "step watchdog [proc 3]"  # single-slice: unchanged
+
+
+def _start_monitor(tmp_path, deaths, timeout_s=0.6, poll_s=0.1):
+    from fms_fsdp_tpu.resilience.slices import SliceHealthMonitor
+
+    return SliceHealthMonitor(
+        str(tmp_path / "hb"),
+        num_slices=2,
+        slice_index=0,
+        process_index=0,
+        timeout_s=timeout_s,
+        poll_s=poll_s,
+        on_dead=deaths.append,
+    ).start()
+
+
+def _write_peer_hb(tmp_path, slice_idx, proc, step=5):
+    import json
+
+    d = tmp_path / "hb"
+    os.makedirs(d, exist_ok=True)
+    with open(d / f"slice{slice_idx}_proc{proc}.hb", "w") as f:
+        json.dump({"slice": slice_idx, "proc": proc, "step": step}, f)
+
+
+def test_slice_monitor_detects_dead_slice(tmp_path):
+    """Peers that wrote liveness once and then went silent for the
+    timeout are declared lost, with the actionable fault-domain
+    message on the healthy host."""
+    deaths = []
+    _write_peer_hb(tmp_path, 1, 2, step=7)
+    _write_peer_hb(tmp_path, 1, 3, step=7)
+    mon = _start_monitor(tmp_path, deaths)
+    try:
+        deadline = time.monotonic() + 5
+        while not deaths and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        mon.stop()
+    assert deaths, "dead slice never detected"
+    msg = deaths[0]
+    assert "slice 1 lost" in msg, msg
+    assert "[proc 0 slice 0]" in msg, msg
+    assert "world minus one fault domain" in msg, msg
+    assert "2 -> 1 slice(s)" in msg, msg
+    assert "(last progress at step 7)" in msg, msg
+
+
+def test_slice_monitor_quiet_while_peers_beat(tmp_path):
+    """A live peer slice (files keep changing) is never declared lost,
+    however long it has existed."""
+    deaths = []
+    mon = _start_monitor(tmp_path, deaths)
+    try:
+        for i in range(12):
+            _write_peer_hb(tmp_path, 1, 2, step=i)
+            time.sleep(0.1)
+    finally:
+        mon.stop()
+    assert not deaths, deaths
+
+
+def test_slice_monitor_own_slice_never_declared(tmp_path):
+    """Stale files of the monitor's OWN slice are not a peer loss (the
+    local process is alive by construction — it is running the scan)."""
+    deaths = []
+    _write_peer_hb(tmp_path, 0, 1)  # a silent peer in MY slice
+    mon = _start_monitor(tmp_path, deaths)
+    try:
+        time.sleep(1.2)
+    finally:
+        mon.stop()
+    assert not deaths, deaths
+
+
+def test_slice_monitor_wait_classify(tmp_path):
+    """The DCN-collective timeout classifier: a caller holding a
+    transport exception blocks until the liveness verdict is in."""
+    deaths = []
+    _write_peer_hb(tmp_path, 1, 2, step=9)
+    mon = _start_monitor(tmp_path, deaths, timeout_s=0.5)
+    try:
+        t0 = time.monotonic()
+        dead = mon.wait_classify()
+        took = time.monotonic() - t0
+    finally:
+        mon.stop()
+    assert dead is not None and dead["slice"] == 1, dead
+    assert took < 5
+    assert "slice 1 lost" in mon.describe_loss(dead)
+
+
+def test_slice_monitor_writes_own_liveness(tmp_path):
+    """The monitor thread (not the possibly-blocked main thread) keeps
+    this process's liveness file fresh."""
+    deaths = []
+    mon = _start_monitor(tmp_path, deaths, timeout_s=5, poll_s=0.05)
+    try:
+        time.sleep(0.3)
+        path = tmp_path / "hb" / "slice0_proc0.hb"
+        assert path.exists()
+        m1 = os.path.getmtime(path)
+        mon.beat(11)
+        time.sleep(0.3)
+        import json
+
+        assert os.path.getmtime(path) >= m1
+        assert json.loads(path.read_text())["step"] == 11
+    finally:
+        mon.stop()
+
+
+def test_multislice_abort_line_names_fault_domain(tmp_path, capsys):
+    """Satellite: on a (simulated) 2-slice mesh the anomaly-guard abort
+    line carries the [proc N slice K] prefix, and the in-process
+    multi-slice entry path (mesh dcn=2, collective-split probe) runs
+    end-to-end on dummy data."""
+    import main_training_llama
+
+    with pytest.raises(RuntimeError, match=r"\[proc 0 slice 0\] anomaly guard"):
+        main_training_llama.main(
+            use_dummy_dataset=True,
+            num_steps=40,
+            seq_length=32,
+            batch_size=2,
+            report_interval=2,
+            checkpoint_interval=1000,
+            anomaly_max_consecutive=4,
+            num_slices=2,
+            vocab_size=256,
+            sharding_strategy="fsdp",
+            attention_kernel="xla",
+            ckpt_save_path=str(tmp_path),
+            ckpt_load_path=str(tmp_path),
+            faults="nan_loss:step=2:count=100",
+            **TINY_OVERRIDES,
+        )
