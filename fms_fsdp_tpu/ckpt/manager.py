@@ -100,6 +100,8 @@ class AsyncCheckpointManager:
         tiers: List[CheckpointTier],
         async_save: bool = True,
         rank=None,
+        durable_retries: int = 3,
+        durable_backoff_s: float = 0.5,
     ):
         assert tiers, "at least one (durable) tier is required"
         self.tiers = tiers
@@ -109,6 +111,16 @@ class AsyncCheckpointManager:
         self.durable = tiers[-1]
         self.async_save = async_save
         self.rank = jax.process_index() if rank is None else rank
+        # transient-FS resilience on the commit path (docs/resilience.md):
+        # manifest/metadata writes retry with bounded backoff
+        # (resilience/retry.py); when the DURABLE tier still fails and a
+        # fast-local tier exists, the manager degrades to it (counter
+        # checkpoint.durable_degraded) instead of killing the background
+        # writer on the first ENOSPC/EIO
+        self.durable_retries = max(0, int(durable_retries))
+        self.durable_backoff_s = float(durable_backoff_s)
+        self._durable_degraded = False
+        self._pending_degraded = 0
         self._observer = None
         self._writer: Optional[threading.Thread] = None
         self._writer_err: Optional[BaseException] = None
@@ -175,8 +187,13 @@ class AsyncCheckpointManager:
             bg_s, self._bg_seconds = self._bg_seconds, 0.0
             done, self._pending_saves = self._pending_saves, []
             in_flight = self._in_flight
+            degraded, self._pending_degraded = self._pending_degraded, 0
         obs = self._observer
         if obs is not None:
+            if degraded:
+                obs.registry.counter("checkpoint.durable_degraded").add(
+                    degraded
+                )
             for tier_name, nbytes, save_bg_s in done:
                 obs.registry.counter("checkpoint.saves").add()
                 obs.registry.counter(f"checkpoint.saves.{tier_name}").add()
@@ -217,10 +234,32 @@ class AsyncCheckpointManager:
             if not due:
                 due = [self.durable]
             if self.durable in due:
-                # a durable-step save satisfies the local cadence too:
-                # the resume scan merges tiers, so a same-step local
-                # copy would only double the write volume
-                due = [self.durable]
+                if (
+                    self._durable_degraded
+                    and len(self.tiers) > 1
+                    and jax.process_count() == 1
+                ):
+                    # durable commits are failing (transient-FS retry
+                    # exhausted): keep a fast-local copy of this step
+                    # too, so SOME tier holds a committed checkpoint
+                    # while the durable path is degraded. A later
+                    # durable commit success re-arms the dedup below.
+                    # Single-process only: _durable_degraded is set by
+                    # rank 0's commit path, so on a multi-process world
+                    # the other ranks cannot see it — a rank-divergent
+                    # tier list would commit a local checkpoint holding
+                    # only rank 0's shards. Multi-process degraded runs
+                    # keep the durable routing (the writer still
+                    # survives and the counter still fires); commits
+                    # resume when the FS recovers.
+                    due = [
+                        t for t in self.tiers if t is not self.durable
+                    ] + [self.durable]
+                else:
+                    # a durable-step save satisfies the local cadence
+                    # too: the resume scan merges tiers, so a same-step
+                    # local copy would only double the write volume
+                    due = [self.durable]
 
             snap_start = time.time()
             jobs = []
@@ -270,11 +309,46 @@ class AsyncCheckpointManager:
                 self._commit_job(jobs, step, meta, background=False)
                 self._raise_pending()
 
-    def _commit_job(self, jobs, step, meta, background=True):
-        """Writer body: wait out the storage write, then commit
-        (manifest → metadata marker), GC the tier, account the time."""
+    def _commit_tier_io(self, tier, save_name, step, meta):
+        """One tier's commit IO (manifest → metadata marker), idempotent
+        so the transient-FS retry wrapper may re-run it. Hosts the
+        ``ckpt_durable_write`` fault site (raises OSError — the injected
+        ENOSPC/EIO the retry must absorb and the degrade path must
+        survive) and the ``ckpt_precommit_kill`` window."""
+        from fms_fsdp_tpu.resilience.exits import EXIT_CODES
         from fms_fsdp_tpu.resilience.faults import fire_fault, maybe_raise_fault
         from fms_fsdp_tpu.resilience.integrity import write_manifest
+
+        if self.rank != 0:
+            return
+        maybe_raise_fault(
+            "ckpt_durable_write", exc_cls=OSError, step=step, tier=tier.name
+        )
+        write_manifest(save_name)
+        # kill window between snapshot and commit marker: the dir is
+        # fully written but uncommitted — resume must skip it and fall
+        # back
+        params = fire_fault("ckpt_precommit_kill", step=step, tier=tier.name)
+        if params is not None:
+            os._exit(int(params.get("code", EXIT_CODES["injected_kill"])))
+        meta_path = os.path.join(save_name, "metadata.json")
+        with open(meta_path + ".tmp", "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(meta_path + ".tmp", meta_path)
+        Checkpointer._maybe_corrupt(save_name, step, tier=tier.name)
+
+    def _commit_job(self, jobs, step, meta, background=True):
+        """Writer body: wait out the storage write, then commit
+        (manifest → metadata marker) with bounded retry on transient FS
+        errors, GC the tier, account the time. A durable tier whose
+        retry budget is exhausted degrades to the fast-local tier
+        (checkpoint.durable_degraded counter; the save dir stays
+        uncommitted and the torn-dir GC reclaims it) instead of killing
+        the writer."""
+        from fms_fsdp_tpu.resilience.faults import maybe_raise_fault
+        from fms_fsdp_tpu.resilience.retry import retry_call
 
         bg_start = time.time()
         try:
@@ -288,23 +362,38 @@ class AsyncCheckpointManager:
                     step=step,
                     tier=tier.name,
                 )
-                if self.rank == 0:
-                    write_manifest(save_name)
-                    # kill window between snapshot and commit marker:
-                    # the dir is fully written but uncommitted — resume
-                    # must skip it and fall back
-                    params = fire_fault(
-                        "ckpt_precommit_kill", step=step, tier=tier.name
+                try:
+                    retry_call(
+                        lambda t=tier, s=save_name: self._commit_tier_io(
+                            t, s, step, meta
+                        ),
+                        retries=self.durable_retries,
+                        backoff_s=self.durable_backoff_s,
+                        describe=f"{tier.name} checkpoint commit [{save_name}]",
                     )
-                    if params is not None:
-                        os._exit(int(params.get("code", 1)))
-                    meta_path = os.path.join(save_name, "metadata.json")
-                    with open(meta_path + ".tmp", "w") as f:
-                        json.dump(meta, f)
-                        f.flush()
-                        os.fsync(f.fileno())
-                    os.replace(meta_path + ".tmp", meta_path)
-                    Checkpointer._maybe_corrupt(save_name, step, tier=tier.name)
+                except OSError as e:
+                    if tier is self.durable and len(self.tiers) > 1:
+                        with self._lock:
+                            self._pending_degraded += 1
+                            self._durable_degraded = True
+                        tier.ckp.report(
+                            f"WARNING: durable checkpoint commit for step "
+                            f"{step} failed after {self.durable_retries} "
+                            f"retries ({e}); degrading to the fast local "
+                            f"tier until a durable commit succeeds "
+                            f"(checkpoint.durable_degraded). The step dir "
+                            f"stays uncommitted; resume falls back to the "
+                            f"newest committed checkpoint on any tier."
+                        )
+                        continue
+                    raise
+                if tier is self.durable and self._durable_degraded:
+                    with self._lock:
+                        self._durable_degraded = False
+                    tier.ckp.report(
+                        f"durable checkpoint commit recovered at step "
+                        f"{step}; leaving degraded mode"
+                    )
                 nbytes = _dir_bytes(save_name) if self.rank == 0 else 0
                 if self._observer is not None:
                     # flushed into the registry by obs_stats() on the
@@ -470,6 +559,8 @@ def build_checkpoint_manager(
         tiers,
         async_save=bool(getattr(cfg, "ckpt_async", True)),
         rank=rank,
+        durable_retries=int(getattr(cfg, "ckpt_durable_retries", 3)),
+        durable_backoff_s=float(getattr(cfg, "ckpt_durable_backoff_s", 0.5)),
     )
     # default elastic fingerprint from the config as given; the llama/
     # mamba/mixtral entries re-stamp after the elastic batch policy has

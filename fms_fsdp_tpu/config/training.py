@@ -44,6 +44,14 @@ class TrainConfig:
     # forks workers (the reference's torch DataLoader model) for host
     # parallelism immune to GIL contention in pure-Python pipeline stages
     worker_mode: str = "thread"
+    # DeviceFeed host->device prefetch depth (data/device_feed.py).
+    # 0 = fully synchronous staging: with num_workers=1 (the workerless
+    # zero-skew loader path) the whole data pipeline advances exactly
+    # with consumption, so a checkpoint's loader state equals the
+    # consumed position and a restart replays nothing AND skips nothing
+    # — the mode chaos certification runs under (scripts/chaos_soak.py).
+    # Production keeps the default double-buffering.
+    feed_prefetch: int = 2
 
     # sharding. ``sharding_strategy`` keeps the reference vocabulary
     # (ddp | fsdp | hsdp | tp, ref:fms_fsdp/config/training.py:31) but maps to
@@ -150,6 +158,15 @@ class TrainConfig:
     # healthy host instead of hanging in the DCN collective. 0 disables.
     slice_heartbeat_dir: str = ""
     slice_timeout_s: float = 0.0
+    # Self-healing run supervisor (docs/resilience.md "Self-healing
+    # supervisor"; resilience/supervisor.py reads these via
+    # supervise_from_config): cap on auto-relaunches, the base of the
+    # doubling relaunch backoff, and how many consecutive restarts may
+    # fail to advance the heartbeat step before the supervisor gives up
+    # with a post-mortem instead of crash-looping forever.
+    max_restarts: int = 8
+    restart_backoff_s: float = 5.0
+    crash_loop_threshold: int = 3
     shard_read_retries: int = 3  # bounded retries per shard IO call
     shard_read_backoff_s: float = 0.5  # initial backoff (doubles per retry)
     loader_worker_restarts: int = 2  # worker restarts before the error surfaces
@@ -171,6 +188,13 @@ class TrainConfig:
     ckpt_local_dir: str = ""  # fast-tier root; "" disables the local tier
     ckpt_local_interval: int = 0  # steps between local-tier saves; 0 disables
     ckpt_local_keep: int = 2  # local-tier retention
+    # Transient-FS resilience on the commit path (docs/resilience.md):
+    # manifest/metadata writes retry with bounded doubling backoff
+    # (resilience/retry.py); a durable tier still failing degrades to
+    # the fast-local tier (checkpoint.durable_degraded counter) instead
+    # of killing the background writer on the first ENOSPC/EIO.
+    ckpt_durable_retries: int = 3
+    ckpt_durable_backoff_s: float = 0.5
     # Elastic resume (docs/checkpointing.md "Elastic resume"): restarts
     # on a different topology preserve the checkpoint's GLOBAL batch by
     # recomputing per-rank rows; when the new data-parallel extent

@@ -189,6 +189,7 @@ class CheckpointDataset(WrapperDataset):
         interval: int,
         steps_per_batch: int = 1,
         save_path: str = "",
+        extra_roots=(),
     ):
         super().__init__(dataset)
         self.interval = interval
@@ -200,13 +201,19 @@ class CheckpointDataset(WrapperDataset):
             save_path = os.path.join(save_path, "checkpoints")
         self.load_path = load_path
         self.path = save_path
+        # additional checkpoint roots the trainer may resolve a restart
+        # from (the async manager's fast-local tier): a step dir under
+        # any of these is a trainer-resolved restore, same as the
+        # primary roots (see load_from_path)
+        self.extra_roots = tuple(extra_roots)
         self.step = 0
         self.ministep = 0
 
     def setup(self):
         if not self.is_setup:
             super().setup()
-            self.load_from_path(self.load_path)
+            if not getattr(self, "_explicit_restore", False):
+                self.load_from_path(self.load_path)
 
     def __iter__(self):
         self.setup()
@@ -277,6 +284,43 @@ class CheckpointDataset(WrapperDataset):
         )
 
     def load_from_path(self, path: str):
+        # The trainer's RESOLVED restart checkpoint — a step dir inside
+        # this run's own checkpoints folder, holding loader state — is
+        # authoritative: the model restored exactly from it, and the
+        # auto-detect below would instead pick the NEWEST loader state
+        # on disk, which after a fallback resume (torn newest
+        # checkpoint skipped, supervisor relaunch after a mid-commit
+        # kill) can be a loader auto-save AHEAD of the model — silently
+        # skipping every batch between the two positions (model@N +
+        # loader@M>N). Restoring the committed pair keeps the resumed
+        # stream exactly the committed stream (scripts/chaos_soak.py
+        # pins bit-identity on this). The flag suppresses setup()'s
+        # auto-load, which would clobber the explicit restore.
+        resolved = os.path.abspath(path)
+        own_roots = {
+            os.path.abspath(p)
+            for p in (self.path, self.load_path, *self.extra_roots)
+        }
+        if (
+            os.path.dirname(resolved) in own_roots
+            and os.path.isdir(resolved)
+            and any("loader" in x for x in safe_listdir(resolved))
+        ):
+            # flag BEFORE setup(): it suppresses setup()'s auto-load, and
+            # setup() must run first — it propagates the (possibly
+            # worker-inflated) rank/worldsize down the wrapper stack,
+            # which the restore's shard partitioning depends on (the
+            # auto-load path gets this ordering from __iter__)
+            self._explicit_restore = True
+            self.setup()
+            self.step = step_number(resolved)
+            start = time.time()
+            self.dataset.load_from_path(resolved)
+            self.report(
+                f"Dataset checkpoint loaded (trainer-resolved "
+                f"{resolved})! Load time: {time.time() - start}"
+            )
+            return
         # a checkpoint in the save dir means this job restarted: prefer it
         save_path = self._validate_ckp_path(self.path, False)
         if len(save_path) > 0:

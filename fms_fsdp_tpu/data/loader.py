@@ -29,6 +29,7 @@ which is where TPU step-time overlap actually comes from.
 """
 
 import multiprocessing
+import os
 import pickle
 import queue
 import threading
@@ -118,6 +119,14 @@ def _service_commands(pipeline, cmd) -> bool:
     return False
 
 
+class LoaderWorkerError(RuntimeError):
+    """A loader worker died and the restart budget could not absorb it.
+    Typed so the entry points' classified-exit wrapper
+    (resilience/exits.py) exits with the ``loader_death`` registry code
+    instead of the generic 1 — the run supervisor restarts a dead data
+    path differently from an anomaly abort or a lost slice."""
+
+
 def _worker_fault(widx: int, produced_count: int):
     """``loader_worker`` fault site, shared by both worker modes: fired
     after each produced batch (filters: worker=, batch=). ``action=exit``
@@ -129,9 +138,12 @@ def _worker_fault(widx: int, produced_count: int):
     if params is None:
         return
     if params.get("action") == "exit":
-        import os
+        from fms_fsdp_tpu.resilience.exits import EXIT_CODES
 
-        os._exit(int(params.get("code", 3)))
+        # the registry's loader_death code, NOT the old hardcoded 3:
+        # that collided with the slice-loss code, so a dead loader
+        # worker classified as a lost slice (resilience/exits.py)
+        os._exit(int(params.get("code", EXIT_CODES["loader_death"])))
     raise RuntimeError(
         f"injected loader worker crash (worker {widx}, "
         f"batch {produced_count})"
@@ -382,9 +394,17 @@ class StatefulDataLoader:
             # workerless path: same generation contract as the worker
             # paths — a later __iter__ (or shutdown) supersedes this
             # iterator, which must raise rather than keep drawing from
-            # the shared pipeline interleaved with its successor
+            # the shared pipeline interleaved with its successor.
+            # Consumption advances the pipeline INLINE, so this path is
+            # zero-skew by construction: a state capture at a step
+            # boundary equals exactly the consumed position, and a
+            # resume replays nothing and skips nothing — the property
+            # chaos certification leans on (scripts/chaos_soak.py, with
+            # feed_prefetch=0 ahead of it).
             self.shutdown()
             stop = self._stop = threading.Event()
+            self._produced = [[0]]
+            self._consumed = [0]
             it = iter(self.pipelines[0])
             while True:
                 if stop.is_set():
@@ -393,7 +413,16 @@ class StatefulDataLoader:
                         "or re-iterated; this generation's stream has "
                         "ended"
                     )
-                yield _stack([next(it) for _ in range(self.batch_size)])
+                batch = _stack([next(it) for _ in range(self.batch_size)])
+                self._produced[0][0] += 1
+                self._consumed[0] += 1
+                # same fault site as the worker modes (fires after each
+                # produced batch): action=exit kills THIS process — in
+                # workerless mode the trainer is the worker, so the
+                # injected loader death surfaces as the classified
+                # loader_death exit the supervisor restarts
+                _worker_fault(0, self._produced[0][0])
+                yield batch
 
         self.shutdown()
         # fresh generation (see __init__); the local binding lets THIS
@@ -461,7 +490,15 @@ class StatefulDataLoader:
                     t.start()
                     continue
                 self.shutdown()
-                raise batch
+                if isinstance(batch, StopIteration):
+                    raise batch
+                # restart budget exhausted: surface typed so the entry's
+                # classified-exit wrapper exits loader_death (the
+                # supervisor's restart policy keys on the cause)
+                raise LoaderWorkerError(
+                    f"loader worker {w} failed and the restart budget "
+                    f"({self.max_worker_restarts}) is exhausted: {batch}"
+                ) from batch
             self._consumed[w] += 1
             yield batch
             w = (w + 1) % self.num_workers
@@ -604,7 +641,12 @@ class StatefulDataLoader:
                     self._spawn_proc_worker(w, ctx, queues)
                     continue
                 self.shutdown()
-                raise batch
+                if isinstance(batch, StopIteration):
+                    raise batch
+                raise LoaderWorkerError(
+                    f"loader worker {w} failed and the restart budget "
+                    f"({self.max_worker_restarts}) is exhausted: {batch}"
+                ) from batch
             self._consumed[w] += 1
             yield batch
             w = (w + 1) % self.num_workers
@@ -964,12 +1006,20 @@ def get_data_loader(cfg, rank, world_size, postprocess=None, batch_multiplier=1)
             f"— both checkpoint scanners pick the newest dir of their own "
             f"kind — but on-disk step numbers won't correlate)"
         )
+    # the fast-local checkpoint tier (docs/checkpointing.md) is another
+    # root the trainer may resolve a restart from; the loader must
+    # honor a trainer-resolved step dir under it exactly like one under
+    # the durable root (model-loader consistency)
+    local_dir = str(getattr(cfg, "ckpt_local_dir", "") or "")
     data = CheckpointDataset(
         data,
         cfg.ckpt_load_path if cfg.resuming_dataset else cfg.ckpt_save_path,
         cfg.checkpoint_interval,
         steps_per_batch,
         cfg.ckpt_save_path,
+        extra_roots=(
+            (os.path.join(local_dir, "checkpoints"),) if local_dir else ()
+        ),
     )
     return StatefulDataLoader(
         data,

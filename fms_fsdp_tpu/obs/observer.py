@@ -45,6 +45,8 @@ class Observer:
         kernel_tuning: Optional[str] = None,
         quantized_matmuls: Optional[str] = None,
         quantized_reduce: Optional[str] = None,
+        restarts: int = 0,
+        restart_downtime_s: float = 0.0,
     ):
         self.registry = MetricRegistry()
         # the kernel-tuning mode this run's step was built under (v3
@@ -56,7 +58,15 @@ class Observer:
         self.quantized_matmuls = quantized_matmuls
         self.quantized_reduce = quantized_reduce
         self.timer = PhaseTimer(clock=clock)
-        self.goodput = GoodputTracker()
+        # supervisor restart accounting (schema v6): how many times this
+        # run has been auto-relaunched, and the cumulative downtime —
+        # pre-charged into the goodput wall clock so a faulted run's
+        # goodput_overall is strictly below the fault-free run's
+        self.restarts = int(restarts)
+        self.restart_downtime_s = float(restart_downtime_s)
+        self.goodput = GoodputTracker(
+            restart_downtime_s=self.restart_downtime_s
+        )
         self.sinks = sinks or []
         self.heartbeat = heartbeat
         self.flops_per_token = flops_per_token
@@ -192,6 +202,9 @@ class Observer:
             "goodput_overall": goodput_all,
             "skipped_steps": int(skipped_steps_total),
             "skipped_steps_window": int(skipped_steps_window),
+            # v6: supervisor restart accounting (restart ledger)
+            "restarts": self.restarts,
+            "restart_downtime_s": self.restart_downtime_s,
             "kernel_tuning": self.kernel_tuning,
             "quantized_matmuls": self.quantized_matmuls,
             "quantized_reduce": self.quantized_reduce,
@@ -293,6 +306,17 @@ def build_observer(
         )
         peak = peak_flops_per_chip(getattr(cfg, "obs_chip_hint", "") or "")
 
+    # self-healing supervisor accounting (schema v6): when relaunched by
+    # resilience/supervisor.py, the restart ledger (FMS_RESTART_LEDGER,
+    # written before each launch) carries how many restarts preceded
+    # this incarnation and their cumulative downtime — folded into every
+    # record and charged against goodput. Unsupervised runs: 0 / 0.0.
+    from fms_fsdp_tpu.resilience.exits import read_restart_ledger
+
+    ledger = read_restart_ledger() or {}
+    restarts = int(ledger.get("restarts", 0) or 0)
+    restart_downtime_s = float(ledger.get("restart_downtime_s", 0.0) or 0.0)
+
     obs = Observer(
         sinks=sinks,
         heartbeat=heartbeat,
@@ -304,6 +328,8 @@ def build_observer(
         kernel_tuning=getattr(cfg, "kernel_tuning", None),
         quantized_matmuls=getattr(cfg, "quantized_matmuls", None),
         quantized_reduce=getattr(cfg, "quantized_reduce", None),
+        restarts=restarts,
+        restart_downtime_s=restart_downtime_s,
     )
     # resolved kernel tiles (kernel.tune.* gauges) land in this
     # observer's registry from the trace-time lookup — attach before the

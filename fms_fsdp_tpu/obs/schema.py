@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -65,6 +65,17 @@ SCHEMA_FIELDS = {
     "goodput_overall": ("float", False),
     "skipped_steps": ("int", True),
     "skipped_steps_window": ("int", True),
+    # v6: self-healing supervisor accounting (docs/resilience.md
+    # "Self-healing supervisor"). The relaunched run reads the
+    # supervisor's restart ledger (FMS_RESTART_LEDGER) at observer
+    # build: ``restarts`` is how many times this run has been
+    # auto-relaunched and ``restart_downtime_s`` the cumulative
+    # death-to-relaunch wall time — charged against goodput (the
+    # GoodputTracker's wall clock starts that far behind), so a faulted
+    # run's goodput_overall is strictly below the fault-free run's.
+    # Unsupervised runs report 0 / 0.0.
+    "restarts": ("int", True),
+    "restart_downtime_s": ("float", True),
     # v3: the kernel-tuning mode the run's step was built under
     # ("auto" | "off" | a table path). The per-kernel resolved tiles ride
     # in ``extra`` as kernel.tune.* gauges (flash block_q/block_k/kvgrid,
@@ -106,6 +117,9 @@ SCHEMA_DIGESTS = {
     # v5: + ici_collective_s / dcn_collective_s (the multi-slice
     # collective split measured by the report-cadence probe)
     5: "5b3a957aa5736c7bce67ed7650ee3f5dc6fc322bc1edb85409dcc4653eddb011",
+    # v6: + restarts / restart_downtime_s (self-healing supervisor:
+    # restart-ledger accounting, downtime charged against goodput)
+    6: "beafaf1c7f6338ad6693fe16ce1b2c4403c5447e3135e12b3776d5494864b8ce",
 }
 
 

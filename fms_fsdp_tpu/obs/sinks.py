@@ -155,11 +155,23 @@ class Heartbeat:
     """Tiny atomically-replaced JSON file — ``{step, time_unix, goodput,
     schema_version}`` — that the StepWatchdog's stall report and external
     orchestrators can poll to tell "alive and progressing" from "alive
-    and wedged" without parsing the full metrics stream."""
+    and wedged" without parsing the full metrics stream.
 
-    def __init__(self, path: str):
+    Supervised runs (resilience/supervisor.py sets ``FMS_RUN_ID``) stamp
+    the incarnation's ``run_id`` into every beat: the supervisor's
+    crash-loop detector and the watchdog's stall report both need to
+    tell a fresh incarnation's progress from the dead run's leftover
+    file on shared storage. Unsupervised runs keep the exact legacy
+    payload."""
+
+    def __init__(self, path: str, run_id: Optional[str] = None):
         self.path = path
         self._broken = False
+        if run_id is None:
+            from fms_fsdp_tpu.resilience.exits import current_run_id
+
+            run_id = current_run_id()
+        self.run_id = run_id or None
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
 
     def beat(self, step: int, time_unix: float, goodput: float) -> None:
@@ -173,6 +185,8 @@ class Heartbeat:
             "goodput": float(goodput),
             "schema_version": SCHEMA_VERSION,
         }
+        if self.run_id:
+            payload["run_id"] = self.run_id
         try:
             d = os.path.dirname(os.path.abspath(self.path))
             fd, tmp = tempfile.mkstemp(dir=d, prefix=".heartbeat.")

@@ -103,11 +103,19 @@ class GoodputTracker:
     ``update`` consumes one report window and returns
     ``(goodput_window, goodput_overall)``; cumulative totals live here
     so the overall number survives across windows.
+
+    ``restart_downtime_s`` (the supervisor's restart ledger,
+    docs/resilience.md "Self-healing supervisor") pre-charges the wall
+    clock: time the run spent dead between incarnations produced no
+    progress, so ``goodput_overall`` for an auto-restarted run is
+    strictly below the same run fault-free. Window goodput is untouched
+    (the downtime did not happen inside any window).
     """
 
-    def __init__(self):
+    def __init__(self, restart_downtime_s: float = 0.0):
+        self.restart_downtime_s = max(0.0, float(restart_downtime_s))
         self.productive_s = 0.0
-        self.wall_s = 0.0
+        self.wall_s = self.restart_downtime_s
 
     def update(
         self,

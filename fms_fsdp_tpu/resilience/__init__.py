@@ -9,6 +9,15 @@ non-finite batch. The preemption half lives in
 - ``faults``    — deterministic, env/config-driven fault injection at
   named sites, so every guard below is testable on CPU
   (``tests/test_resilience.py``);
+- ``exits``     — the central exit-code registry every fail-fast site
+  adopts (collision-free by test), the per-incarnation run-id plumbing,
+  and the classified-exit entry wrapper;
+- ``supervisor`` — the self-healing run supervisor: launches the
+  training entry as child processes, maps each incarnation's exit
+  classification to a restart policy, relaunches through elastic
+  resume, detects crash loops, and writes the restart ledger that
+  charges downtime against goodput (docs/resilience.md "Self-healing
+  supervisor");
 - ``guards``    — host-side anomaly accounting over the in-jit
   non-finite flag (skip/report/abort) and a wall-clock step watchdog;
 - ``slices``    — multi-slice fault domains: per-slice liveness
@@ -24,6 +33,14 @@ non-finite batch. The preemption half lives in
 Recovery semantics are documented in docs/resilience.md.
 """
 
+from fms_fsdp_tpu.resilience.exits import (
+    EXIT_CODES,
+    classified_exit,
+    classify_exit,
+    classify_world,
+    current_run_id,
+    exit_code,
+)
 from fms_fsdp_tpu.resilience.faults import (
     configure_faults,
     fault_params,
@@ -36,14 +53,21 @@ from fms_fsdp_tpu.resilience.integrity import (
     write_manifest,
 )
 from fms_fsdp_tpu.resilience.retry import RetryingShardHandler, retry_call
-from fms_fsdp_tpu.resilience.slices import SliceHealthMonitor
+from fms_fsdp_tpu.resilience.slices import SliceHealthMonitor, SliceLostError
 
 __all__ = [
     "AnomalyGuard",
+    "EXIT_CODES",
     "RetryingShardHandler",
     "SliceHealthMonitor",
+    "SliceLostError",
     "StepWatchdog",
+    "classified_exit",
+    "classify_exit",
+    "classify_world",
     "configure_faults",
+    "current_run_id",
+    "exit_code",
     "fault_params",
     "fire_fault",
     "maybe_raise_fault",

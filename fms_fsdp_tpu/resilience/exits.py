@@ -1,0 +1,207 @@
+"""Central exit-code registry: every fail-fast site exits with a code
+the run supervisor can map to a restart policy.
+
+The resilience stack deliberately ends every unrecoverable failure in a
+fail-fast exit (``os._exit`` from a watchdog thread, ``SystemExit`` from
+a classified entry-point wrapper) so the process never burns a
+reservation hanging in a dead collective. Before this registry each site
+picked its own code ad hoc — the loader's injected-kill default (3)
+collided with the slice-loss code, so a dead loader classified as a lost
+slice. Now there is ONE table; a uniqueness test
+(tests/test_supervisor.py) keeps it collision-free, and
+``resilience/supervisor.py`` maps each class to a restart policy
+(docs/resilience.md "Self-healing supervisor").
+
+==================  ====  ===================================================
+class               code  exited by
+==================  ====  ===================================================
+ok                  0     a run that reached num_steps (or a clean
+                          preemption exit — the supervisor tells the two
+                          apart by the heartbeat step vs its target)
+error               1     any unclassified Python exception (the
+                          interpreter default; never exited explicitly)
+watchdog_stall      2     StepWatchdog (resilience/guards.py): no training
+                          progress inside step_timeout_s
+slice_loss          3     SliceHealthMonitor (resilience/slices.py): every
+                          process of a peer fault domain went silent; also
+                          the classified re-raise path (SliceLostError
+                          through the entry wrapper)
+anomaly_abort       4     the anomaly guard's DeliberateAbort through the
+                          entry wrapper: K consecutive non-finite steps,
+                          checkpoint saved, aborting on purpose
+loader_death        5     LoaderWorkerError through the entry wrapper: a
+                          loader worker died and the restart budget is
+                          exhausted (also the loader_worker fault site's
+                          ``action=exit`` default for the worker process
+                          itself)
+preempted           6     reserved for schedulers that need preemption
+                          nonzero; the in-repo loop exits 0 after the
+                          preemption save ("exiting clean") and the
+                          supervisor classifies it from the heartbeat step
+injected_kill       7     fault-injection hard-kills (slice_kill,
+                          ckpt_precommit_kill) when the spec carries no
+                          explicit ``code=``
+==================  ====  ===================================================
+
+``classify_world`` merges one incarnation's per-host exit codes into the
+single most-causal class: a loader death on one host surfaces on its
+peers as a slice loss or watchdog stall (the collective died under
+them), and the restart policy must key on the cause, not the echo.
+
+Run incarnations: the supervisor exports ``FMS_RUN_ID`` (identical on
+every host of one incarnation) and ``FMS_RESTART_LEDGER`` (the restart
+ledger path). ``current_run_id``/``read_restart_ledger`` are the child-
+side readers — the heartbeat and slice-liveness files stamp the run id
+so a freshly restarted run never mistakes the dead incarnation's records
+for live progress, and the observer folds the ledger's restart downtime
+into goodput (obs schema v6 ``restarts``/``restart_downtime_s``).
+"""
+
+import contextlib
+import json
+import os
+import sys
+import traceback
+from typing import Dict, Iterable, Optional
+
+ENV_RUN_ID = "FMS_RUN_ID"
+ENV_LEDGER = "FMS_RESTART_LEDGER"
+
+EXIT_CODES: Dict[str, int] = {
+    "ok": 0,
+    "error": 1,
+    "watchdog_stall": 2,
+    "slice_loss": 3,
+    "anomaly_abort": 4,
+    "loader_death": 5,
+    "preempted": 6,
+    "injected_kill": 7,
+}
+
+# most-causal-first: when one incarnation's hosts exit with different
+# codes (the cause on one host, its echoes on the peers), the world
+# classifies as the first class present in this order. loader_death and
+# anomaly_abort outrank slice_loss/watchdog_stall because a single dead
+# process IS a dead fault domain to a 1-host slice's peers — the echo
+# must not pick the restart policy.
+CLASSIFY_PRIORITY = (
+    "loader_death",
+    "anomaly_abort",
+    "slice_loss",
+    "watchdog_stall",
+    "preempted",
+    "injected_kill",
+    "error",
+    "ok",
+)
+
+
+def exit_code(name: str) -> int:
+    return EXIT_CODES[name]
+
+
+def classify_exit(code: Optional[int]) -> str:
+    """Exit code -> class name. Unknown nonzero codes (including signal
+    deaths, surfaced by subprocess as negative codes) classify as
+    ``error`` — the supervisor's bounded generic retry."""
+    if code is None:
+        return "error"
+    for name, c in EXIT_CODES.items():
+        if c == code:
+            return name
+    return "error"
+
+
+def classify_world(codes: Iterable[Optional[int]]) -> str:
+    """Merge one incarnation's per-host exit codes into the single
+    most-causal class (see CLASSIFY_PRIORITY)."""
+    classes = {classify_exit(c) for c in codes}
+    for name in CLASSIFY_PRIORITY:
+        if name in classes:
+            return name
+    return "ok"
+
+
+def current_run_id() -> Optional[str]:
+    """The incarnation id the supervisor exported for this process, or
+    None when running unsupervised. Identical on every host of one
+    incarnation (the supervisor derives it from its attempt counter), so
+    it is safe to compare across a shared filesystem."""
+    return os.environ.get(ENV_RUN_ID) or None
+
+
+def read_restart_ledger(path: Optional[str] = None) -> Optional[dict]:
+    """The supervisor's restart ledger (written BEFORE each launch so
+    the child can fold prior downtime into goodput), or None when absent
+    or unreadable — a torn ledger must never block a restart."""
+    path = path or os.environ.get(ENV_LEDGER) or ""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def classify_exception(e: BaseException) -> Optional[str]:
+    """Exit class for a classified failure type, or None (unclassified —
+    let the interpreter exit 1). Types are imported lazily: this runs on
+    the crash path and must not create import cycles; a failing import
+    just skips that classification."""
+    checks = []
+    try:
+        from fms_fsdp_tpu.utils.train_utils import DeliberateAbort
+
+        checks.append((DeliberateAbort, "anomaly_abort"))
+    except Exception:  # noqa: BLE001 — crash path: classify what we can
+        pass
+    try:
+        from fms_fsdp_tpu.resilience.slices import SliceLostError
+
+        checks.append((SliceLostError, "slice_loss"))
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from fms_fsdp_tpu.data.loader import LoaderWorkerError
+
+        checks.append((LoaderWorkerError, "loader_death"))
+    except Exception:  # noqa: BLE001
+        pass
+    for typ, name in checks:
+        if isinstance(e, typ):
+            return name
+    return None
+
+
+@contextlib.contextmanager
+def classified_exit():
+    """Entry-point wrapper: map classified failure types onto registry
+    exit codes so the supervisor reads the cause from the exit status.
+
+    Wraps the ``__main__`` body of every training entry (the three
+    pretraining mains, the speculator loop, and the test child). The
+    traceback still prints — classification changes the exit code, not
+    the post-mortem. Unclassified exceptions propagate untouched
+    (interpreter exit 1 == the registry's ``error``).
+
+    Classified failures exit via ``os._exit`` (like every other
+    fail-fast site): normal interpreter teardown runs the jax
+    distributed service's atexit shutdown barrier, which — with a dead
+    peer, exactly the classified case — aborts the process (SIGABRT)
+    and would clobber the classified code the supervisor reads."""
+    try:
+        yield
+    except (SystemExit, KeyboardInterrupt):
+        raise
+    except BaseException as e:  # noqa: BLE001 — classification boundary
+        name = classify_exception(e)
+        if name is None:
+            raise
+        traceback.print_exc()
+        sys.stderr.write(
+            f"exit classified: {name} (exit {EXIT_CODES[name]})\n"
+        )
+        sys.stderr.flush()
+        sys.stdout.flush()
+        os._exit(EXIT_CODES[name])

@@ -28,11 +28,20 @@ ckpt_writer_crash
 ckpt_precommit_kill
                 AsyncCheckpointManager's writer, between the snapshot
                 (fully written dir) and the metadata.json commit marker
-                (hard-exits the process with ``code``, default 1) — the
-                mid-save kill whose torn dir resume must skip
+                (hard-exits the process with ``code``, default the
+                ``injected_kill`` registry code) — the mid-save kill
+                whose torn dir resume must skip
+ckpt_durable_write
+                AsyncCheckpointManager's per-tier commit IO, before the
+                manifest write (raises OSError — injected ENOSPC/EIO).
+                ``times=K`` within the retry budget is absorbed by the
+                bounded commit retry; an unbounded fault on the durable
+                tier exhausts it and triggers the degrade-to-local path
+                (checkpoint.durable_degraded counter)
 slice_kill      the train loop's step boundary, before the step is
                 dispatched (hard-exits the process with ``code``,
-                default 1). Filtered by ``slice``/``step``, it kills
+                default the ``injected_kill`` registry code,
+                resilience/exits.py). Filtered by ``slice``/``step``, it kills
                 every process of one fault domain at once — the
                 whole-slice preemption the SliceHealthMonitor must
                 detect and the surviving slices must classify

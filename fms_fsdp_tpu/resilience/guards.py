@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Iterable
 
+from fms_fsdp_tpu.resilience.exits import EXIT_CODES, current_run_id
+
 logger = logging.getLogger(__name__)
 
 
@@ -87,9 +89,15 @@ class StepWatchdog:
     additionally names the host's fault domain, so a multi-slice stall
     triage reads "[proc N slice K]" and goes straight to the slice
     (docs/resilience.md "Slice fault domains").
+
+    ``run_id`` (optional; defaults to the supervisor-exported
+    ``FMS_RUN_ID``) guards the heartbeat quote against incarnations: a
+    freshly restarted run inherits the DEAD run's heartbeat.json on
+    shared storage, and quoting it unlabeled would make the stall report
+    claim progress this incarnation never made.
     """
 
-    EXIT_CODE = 2
+    EXIT_CODE = EXIT_CODES["watchdog_stall"]
 
     def __init__(
         self,
@@ -98,6 +106,7 @@ class StepWatchdog:
         heartbeat_path=None,
         process_index=None,
         slice_index=None,
+        run_id=None,
     ):
         assert timeout_s > 0
         self.timeout_s = timeout_s
@@ -105,6 +114,7 @@ class StepWatchdog:
         self.heartbeat_path = heartbeat_path
         self.process_index = process_index
         self.slice_index = slice_index
+        self.run_id = current_run_id() if run_id is None else run_id
         if process_index is None:
             self._tag = "step watchdog"
         elif slice_index is None:
@@ -146,30 +156,51 @@ class StepWatchdog:
     def stop(self) -> None:
         self._stop.set()
 
+    def _stall_report(self, stalled: float) -> str:
+        """The stall message (separate from the exit so tests can pin
+        it without dying). A heartbeat stamped by a DIFFERENT
+        incarnation (run_id mismatch) is quoted but labeled stale — a
+        restarted run must not read the dead run's heartbeat as its own
+        progress."""
+        lines = [
+            f"{self._tag}: no training progress for "
+            f"{stalled:.1f}s (timeout {self.timeout_s}s); dumping "
+            f"stacks and exiting {self.EXIT_CODE}"
+        ]
+        if self.heartbeat_path:
+            # read inline (no project imports): the process is
+            # wedged — the stall path must not risk an import
+            # lock held by the stuck main thread
+            try:
+                with open(self.heartbeat_path) as f:
+                    hb = json.load(f)
+            except (OSError, ValueError):
+                hb = None
+            stale = ""
+            if (
+                isinstance(hb, dict)
+                and self.run_id
+                and hb.get("run_id") not in (None, self.run_id)
+            ):
+                stale = (
+                    " [STALE: written by a previous incarnation "
+                    f"(run_id {hb.get('run_id')!r}, ours "
+                    f"{self.run_id!r}) — this run made no reported "
+                    "progress]"
+                )
+            lines.append(
+                f"{self._tag}: last heartbeat "
+                f"({self.heartbeat_path}): {hb}{stale}"
+            )
+        return "\n".join(lines) + "\n"
+
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):
             if self._paused:
                 continue
             stalled = time.monotonic() - self._last_beat
             if stalled > self.timeout_s:
-                sys.stderr.write(
-                    f"{self._tag}: no training progress for "
-                    f"{stalled:.1f}s (timeout {self.timeout_s}s); dumping "
-                    f"stacks and exiting {self.EXIT_CODE}\n"
-                )
-                if self.heartbeat_path:
-                    # read inline (no project imports): the process is
-                    # wedged — the stall path must not risk an import
-                    # lock held by the stuck main thread
-                    try:
-                        with open(self.heartbeat_path) as f:
-                            hb = json.load(f)
-                    except (OSError, ValueError):
-                        hb = None
-                    sys.stderr.write(
-                        f"{self._tag}: last heartbeat "
-                        f"({self.heartbeat_path}): {hb}\n"
-                    )
+                sys.stderr.write(self._stall_report(stalled))
                 sys.stderr.flush()
                 try:
                     faulthandler.dump_traceback(file=sys.stderr, all_threads=True)

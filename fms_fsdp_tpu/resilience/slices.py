@@ -51,9 +51,21 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
-EXIT_CODE = 3
+from fms_fsdp_tpu.resilience.exits import EXIT_CODES, current_run_id
+
+EXIT_CODE = EXIT_CODES["slice_loss"]
 
 _HB_SUFFIX = ".hb"
+
+
+class SliceLostError(RuntimeError):
+    """A failure the liveness verdict classified as a lost fault domain
+    ("slice K lost ... restart at world minus one fault domain"). Typed
+    so the entry points' classified-exit wrapper
+    (resilience/exits.py) maps it onto the ``slice_loss`` registry exit
+    code — the same code the monitor thread's direct ``os._exit`` uses —
+    whichever way the failure surfaced (hang vs dead-peer transport
+    error)."""
 
 
 def _hb_name(slice_index: int, process_index: int) -> str:
@@ -78,6 +90,15 @@ class SliceHealthMonitor:
     the monitor thread, so a blocked main thread keeps beating liveness
     but not progress). ``on_dead`` (tests) replaces the default
     report-and-``os._exit`` action.
+
+    ``run_id`` (defaults to the supervisor-exported ``FMS_RUN_ID``,
+    identical on every host of one incarnation) stamps this process's
+    liveness file and filters the scan: liveness files left behind by a
+    PREVIOUS incarnation are ignored entirely — a freshly restarted
+    world must not declare a slice lost off the dead world's stale
+    files. Unsupervised runs (no run id) scan every file, as before;
+    the supervisor additionally clears the directory between
+    incarnations.
     """
 
     EXIT_CODE = EXIT_CODE
@@ -92,6 +113,7 @@ class SliceHealthMonitor:
         poll_s: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
         on_dead: Optional[Callable[[str], None]] = None,
+        run_id: Optional[str] = None,
     ):
         assert timeout_s > 0 and num_slices > 1
         self.dir = heartbeat_dir
@@ -104,6 +126,7 @@ class SliceHealthMonitor:
         )
         self._clock = clock
         self._on_dead = on_dead
+        self.run_id = current_run_id() if run_id is None else (run_id or None)
         self._tag = (
             f"slice-health [proc {self.process_index} "
             f"slice {self.slice_index}]"
@@ -112,9 +135,11 @@ class SliceHealthMonitor:
         self._last_progress = clock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # path -> (mtime fingerprint, local clock when first seen at it):
-        # staleness is "unchanged across local polls", never a wall-clock
-        # age comparison against a possibly-skewed storage server
+        # path -> (mtime fingerprint, local clock when first seen at it,
+        # the file's run_id stamp): staleness is "unchanged across local
+        # polls", never a wall-clock age comparison against a possibly-
+        # skewed storage server; the run_id filters out a previous
+        # incarnation's leftovers
         self._marks: Dict[str, tuple] = {}
         self._dead: Optional[dict] = None
 
@@ -144,16 +169,16 @@ class SliceHealthMonitor:
         )
         tmp = path + ".tmp"
         try:
+            payload = {
+                "slice": self.slice_index,
+                "proc": self.process_index,
+                "step": self._step,
+                "time_unix": time.time(),
+            }
+            if self.run_id:
+                payload["run_id"] = self.run_id
             with open(tmp, "w") as f:
-                json.dump(
-                    {
-                        "slice": self.slice_index,
-                        "proc": self.process_index,
-                        "step": self._step,
-                        "time_unix": time.time(),
-                    },
-                    f,
-                )
+                json.dump(payload, f)
             os.replace(tmp, path)
         except OSError:
             pass  # a transient shared-fs hiccup must not kill the writer
@@ -182,10 +207,25 @@ class SliceHealthMonitor:
                 continue
             marked = self._marks.get(path)
             if marked is None or marked[0] != m:
-                self._marks[path] = (m, now)
-                age = 0.0
-            else:
-                age = now - marked[1]
+                # (re)marking: read the file's incarnation stamp once
+                # per mtime change (atomic replace — never torn)
+                file_run = None
+                try:
+                    with open(path) as f:
+                        file_run = json.load(f).get("run_id")
+                except (OSError, ValueError):
+                    pass
+                marked = self._marks[path] = (m, now, file_run)
+            age = now - marked[1]
+            if (
+                self.run_id
+                and marked[2] is not None
+                and marked[2] != self.run_id
+            ):
+                # a previous incarnation's file: its processes are dead
+                # by definition (the world restarted) — not evidence of
+                # a lost slice in THIS incarnation
+                continue
             by_slice.setdefault(s, []).append((p, path, age))
         for s, entries in sorted(by_slice.items()):
             if s == self.slice_index or not entries:

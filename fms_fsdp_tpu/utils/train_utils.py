@@ -362,7 +362,10 @@ def _train_loop(
     from fms_fsdp_tpu.parallel.mesh import process_slice_context
     from fms_fsdp_tpu.resilience.faults import fire_fault
     from fms_fsdp_tpu.resilience.guards import AnomalyGuard, StepWatchdog
-    from fms_fsdp_tpu.resilience.slices import SliceHealthMonitor
+    from fms_fsdp_tpu.resilience.slices import (
+        SliceHealthMonitor,
+        SliceLostError,
+    )
     from fms_fsdp_tpu.train.step import wrap_step_fn
 
     window = []
@@ -567,7 +570,9 @@ def _train_loop(
             # SliceHealthMonitor must detect/classify
             kill = fire_fault("slice_kill", step=batch_idx, slice=slice_idx)
             if kill is not None:
-                os._exit(int(kill.get("code", 1)))
+                from fms_fsdp_tpu.resilience.exits import EXIT_CODES
+
+                os._exit(int(kill.get("code", EXIT_CODES["injected_kill"])))
             stall = fire_fault(
                 "dcn_reduce_stall", step=batch_idx, slice=slice_idx
             )
@@ -668,7 +673,11 @@ def _train_loop(
         if monitor is not None and not isinstance(e, DeliberateAbort):
             dead = monitor.wait_classify()
             if dead is not None:
-                raise RuntimeError(monitor.describe_loss(dead)) from e
+                # typed (resilience/slices.py) so the entry points'
+                # classified-exit wrapper exits with the slice_loss
+                # registry code — the same code the monitor thread's
+                # direct os._exit path uses
+                raise SliceLostError(monitor.describe_loss(dead)) from e
         raise
     finally:
         if watchdog:

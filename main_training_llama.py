@@ -127,9 +127,14 @@ def main(**kwargs):
     # multi-tier manager built above — blocking snapshot at the step
     # boundary, shard/manifest/commit on a background writer, optional
     # fast local tier alongside the durable one (docs/checkpointing.md)
+    # the stateful loader rides along so it restores from the SAME
+    # resolved checkpoint dir as the model (data/buffering.py
+    # CheckpointDataset.load_from_path): after a fallback resume a
+    # loader auto-save can sit AHEAD of the model checkpoint, and the
+    # auto-detect alone would silently skip the batches between the two
     state, _, start_step, tokens_seen, is_resuming = checkpointer.load(
         state,
-        None,
+        ckpt_loader,
         # a run-root load path points at its checkpoints/ subdir; a file
         # path loads directly (ref:main_training_llama.py:124-127)
         path=os.path.join(cfg.ckpt_load_path, "checkpoints/")
@@ -164,7 +169,7 @@ def main(**kwargs):
     feed = DeviceFeed(
         rebatch(loader, local_batch, cfg.batch_size),
         mesh,
-        prefetch=2,
+        prefetch=max(0, int(getattr(cfg, "feed_prefetch", 2))),
         registry=observer.registry,
     )
 
@@ -187,4 +192,10 @@ def main(**kwargs):
 
 
 if __name__ == "__main__":
-    main(**parse_cli_args(sys.argv[1:]))
+    # classified failures (anomaly abort, classified slice loss, loader
+    # death) exit with their registry code (resilience/exits.py) so the
+    # self-healing supervisor maps exit -> restart policy
+    from fms_fsdp_tpu.resilience.exits import classified_exit
+
+    with classified_exit():
+        main(**parse_cli_args(sys.argv[1:]))

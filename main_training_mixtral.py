@@ -34,4 +34,9 @@ def main(**kwargs):
 
 
 if __name__ == "__main__":
-    main(**parse_cli_args(sys.argv[1:]))
+    # classified-exit mapping for the self-healing supervisor, same as
+    # the llama entry (resilience/exits.py)
+    from fms_fsdp_tpu.resilience.exits import classified_exit
+
+    with classified_exit():
+        main(**parse_cli_args(sys.argv[1:]))

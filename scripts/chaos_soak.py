@@ -1,0 +1,477 @@
+"""Chaos-soak harness: seeded fault schedules under the self-healing
+supervisor, proved bit-identical to a fault-free run.
+
+The soak drives the production llama stack (tests/_elastic_child.py — the
+same child the elastic-resume gloo e2e uses) on a 2-slice x 1-host gloo
+CPU world through a SEEDED schedule of kill-class faults sampled from the
+``resilience/faults.py`` registry, one fault per incarnation, all
+restarts performed by ``resilience/supervisor.py`` with no operator in
+the loop. It then runs the identical config fault-free and asserts:
+
+- **same step count**: both runs reach ``--budget-steps``;
+- **bit-identical end state**: the final committed checkpoint's
+  topology-independent STATE_HASH matches the fault-free run's;
+- **zero replayed documents**: the *effective* trainer-consumed stream —
+  each incarnation's per-rank walk truncated to its committed prefix
+  (work past the last commit is redone by design; the ``B`` batch
+  separators in the walk files mark step boundaries) — contains every
+  document marker at most once, and equals the fault-free stream as a
+  multiset;
+- **downtime charged to goodput**: the final metrics.jsonl record
+  carries schema-v6 ``restarts``/``restart_downtime_s`` from the restart
+  ledger (pre-charged into the incarnation's ``goodput_overall``), and
+  the faulted run's RUN-LEVEL goodput — committed steps per wall second
+  from first launch to completion, downtime included — is strictly
+  below the fault-free run's. (Per-incarnation window goodput counts
+  every incarnation's recompile as compute, so it cannot fairly compare
+  a restarted run against a straight one at CPU-test scale.)
+
+Fault pool (kill-class — the run dies and the supervisor relaunches it
+through elastic resume, so every redone step is bit-identical):
+
+- ``slice_kill``          whole-slice loss (always scheduled — the
+                          acceptance criterion's fault domain kill)
+- ``ckpt_precommit_kill`` death between snapshot and commit marker
+- ``dcn_reduce_stall``    a parked rank; the step watchdog converts the
+                          hang into a classified exit
+- ``loader_worker``       (action=exit) loader death: in the workerless
+                          zero-skew mode the trainer IS the worker, so
+                          the injected kill surfaces as the classified
+                          loader_death exit
+
+``nan_loss`` bursts are deliberately NOT in the identity pool: a
+non-finite burst makes the guard *skip* updates the fault-free run
+applies, so the end states legitimately diverge — recovery from them is
+covered by tests/test_resilience.py instead. The supervisor restarts
+with ``on_slice_loss="same"`` (the lost slice "comes back"): end-state
+bit-identity versus a fixed-topology reference requires every
+incarnation to train on the same topology. The shrink policy
+(``num_slices - 1``) is exercised by tests/test_supervisor.py, where
+identity is asserted at the restore boundary exactly as the elastic
+e2e does.
+
+CI smoke: ``python scripts/chaos_soak.py --seed 0 --budget-steps 24``
+(docs/resilience.md "Self-healing supervisor").
+"""
+
+import argparse
+import json
+import os
+import random
+import shutil
+import socket
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+CHILD = os.path.join(REPO, "tests", "_elastic_child.py")
+
+SEQ_LEN = 64
+REPORT_INTERVAL = 2
+SLICE_TIMEOUT_S = 8
+STEP_TIMEOUT_S = 45
+STALL_SECONDS = 900  # >> STEP_TIMEOUT_S: the watchdog ends it
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _marked_corpus(root, n_shards=4, docs_per_shard=200, doc_len=80):
+    """Arrow corpus where doc d opens with unique marker 1024+d (same
+    construction as tests/test_elastic.py): a marker appearing twice in
+    the effective consumed stream is a replayed document."""
+    import pyarrow as pa
+
+    root = str(root)
+    os.makedirs(os.path.join(root, "dataset_1"), exist_ok=True)
+    schema = pa.schema([pa.field("tokens", pa.uint32())])
+    rows, d = [], 0
+    for s in range(n_shards):
+        path = os.path.join(root, "dataset_1", f"shard_{s}.arrow")
+        with pa.ipc.new_file(path, schema) as w:
+            for _ in range(docs_per_shard):
+                body = [(d * 31 + j) % 997 + 1 for j in range(doc_len - 1)]
+                w.write(pa.record_batch([[1024 + d] + body], schema))
+                d += 1
+        rows.append(
+            (f"/dataset_1/shard_{s}.arrow", docs_per_shard,
+             docs_per_shard * doc_len)
+        )
+    os.makedirs(os.path.join(root, "meta"), exist_ok=True)
+    with open(os.path.join(root, "meta", "combined_counts.csv"), "w") as f:
+        f.write("dataset/filename,documents,tokens\n")
+        for name, docs, toks in rows:
+            f.write(f"{name},{docs},{toks}\n")
+    return root
+
+
+def sample_schedule(seed: int, budget: int, ckpt_interval: int, n_sites: int):
+    """The seeded fault schedule: one fault spec per incarnation,
+    ``slice_kill`` always first (the world is still 2-slice and the
+    whole-domain loss is the acceptance criterion), the rest drawn from
+    the registry pool at ascending steps so each fault fires after the
+    previous incarnation's resume point."""
+    rng = random.Random(seed)
+    pool = ["ckpt_precommit_kill", "dcn_reduce_stall", "loader_worker"]
+    rng.shuffle(pool)
+    sites = ["slice_kill"] + pool[: max(0, n_sites - 1)]
+    # ascending fire positions, >= one commit apart so every resume
+    # point (a committed multiple of ckpt_interval) precedes the next
+    # fault; jitter keeps the schedule seed-dependent
+    positions, pos = [], ckpt_interval + 2
+    for _ in sites:
+        positions.append(min(pos + rng.randrange(0, 2), budget - 2))
+        pos = positions[-1] + ckpt_interval + 2
+    schedule = []
+    for site, p in zip(sites, positions):
+        if site == "slice_kill":
+            spec = f"slice_kill:slice=1:step={p}"
+        elif site == "ckpt_precommit_kill":
+            # must land on the commit cadence to fire
+            at = min(((p + ckpt_interval - 1) // ckpt_interval)
+                     * ckpt_interval, budget - ckpt_interval)
+            spec = f"ckpt_precommit_kill:step={at}"
+        elif site == "dcn_reduce_stall":
+            spec = f"dcn_reduce_stall:slice=1:step={p}:seconds={STALL_SECONDS}"
+        else:  # loader_worker: produced-batch clock restarts per
+            # incarnation, so a small count fires early in its attempt
+            spec = "loader_worker:worker=0:batch=3:action=exit"
+        schedule.append((site, spec))
+    return schedule
+
+
+def child_specs(ckpt, data, walk, obs_dir, hb_dir, phase, num_steps,
+                ckpt_interval, faults=""):
+    """Per-rank child specs for one 2-proc (2 slices x 1 host, 4 virtual
+    devices each) incarnation, in the supervisor's spec format."""
+    port = _free_port()
+    overrides = [
+        "num_slices=2",
+        f"slice_heartbeat_dir={hb_dir}",
+        f"slice_timeout_s={SLICE_TIMEOUT_S}",
+        f"step_timeout_s={STEP_TIMEOUT_S}",
+        # zero-skew data path (see module docstring): num_workers=1 is
+        # the loader's workerless inline mode and feed_prefetch=0 makes
+        # device staging synchronous, so every checkpoint's loader state
+        # equals the consumed position exactly — restarts replay nothing
+        # AND skip nothing, which is what makes end-state bit-identity
+        # vs the fault-free run a provable property
+        "feed_prefetch=0",
+        f"obs_dir={obs_dir}",
+    ]
+    specs = []
+    for pid in range(2):
+        specs.append(
+            {
+                "argv": [
+                    sys.executable, "-u", CHILD, ckpt, data, walk, phase,
+                    str(num_steps), str(ckpt_interval), faults, *overrides,
+                ],
+                "env": {
+                    "JAX_PLATFORMS": "cpu",
+                    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                    "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+                    "NUM_PROCESSES": "2",
+                    "PROCESS_ID": str(pid),
+                },
+                "cwd": REPO,
+            }
+        )
+    return specs
+
+
+def _grab(path, key, default=None):
+    try:
+        with open(path) as f:
+            for line in f:
+                if line.startswith(key + " "):
+                    return line.split(" ", 1)[1].strip()
+    except OSError:
+        pass
+    return default
+
+
+def _walk_batches(walk_dir, phase, rank):
+    """The per-rank walk as a list of batches (marker lists), split on
+    the ``B`` separator lines."""
+    path = os.path.join(walk_dir, f"walk_{phase}_rank{rank}.txt")
+    batches, cur = [], None
+    try:
+        with open(path) as f:
+            for tok in f.read().split():
+                if tok == "B":
+                    cur = []
+                    batches.append(cur)
+                elif cur is not None:
+                    cur.append(int(tok))
+    except OSError:
+        pass
+    return batches
+
+
+def effective_markers(walk_dir, phases_with_windows):
+    """Reconstruct the effective (committed) consumed stream: for each
+    (phase, start_step, committed_end) take the first committed_end -
+    start_step batches of every rank's walk — work past the last commit
+    was redone by the next incarnation and is excluded by design."""
+    markers = []
+    for phase, start, end in phases_with_windows:
+        take = max(0, end - start)
+        for rank in range(16):  # ranks present on disk only
+            batches = _walk_batches(walk_dir, phase, rank)
+            if not batches and rank > 0:
+                break
+            for b in batches[:take]:
+                markers.extend(b)
+    return markers
+
+
+def _fired_faults(entries):
+    """How many ledger entries ended in an INJECTED fault: at least one
+    child exited with a registry code (the os._exit / classified-exit
+    paths), which environment failures (SIGABRT, generic tracebacks)
+    never produce."""
+    registry = {2, 3, 4, 5, 7}
+    return sum(
+        1
+        for e in entries
+        if any(code in registry for code in (e.get("exit_codes") or []))
+    )
+
+
+def last_metrics_record(obs_dir):
+    try:
+        with open(os.path.join(obs_dir, "metrics.jsonl")) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        return json.loads(lines[-1]) if lines else None
+    except (OSError, ValueError):
+        return None
+
+
+def run_soak(args, workdir):
+    from fms_fsdp_tpu.resilience.supervisor import RunSupervisor
+
+    data = _marked_corpus(os.path.join(workdir, "data"))
+    budget, interval = args.budget_steps, args.ckpt_interval
+    schedule = sample_schedule(args.seed, budget, interval, args.sites)
+    print(f"chaos schedule (seed {args.seed}):")
+    for site, spec in schedule:
+        print(f"  {site}: {spec}")
+
+    results = {}
+    for kind in ("faulted", "clean"):
+        root = os.path.join(workdir, kind)
+        ckpt = os.path.join(root, "ckpt")
+        walk = os.path.join(root, "walk")
+        obs = os.path.join(root, "obs")
+        hb = os.path.join(root, "slice_hb")
+        logs = os.path.join(root, "logs")
+        os.makedirs(walk, exist_ok=True)
+        plan = schedule if kind == "faulted" else []
+
+        def build(ctx, _plan=plan, _dirs=(ckpt, walk, obs, hb)):
+            c, w, o, h = _dirs
+            k = ctx["attempt"]
+            # arm fault i only after i faults have FIRED: an injected
+            # kill always leaves at least one child on a registry exit
+            # code (os._exit paths: 2/3/5/7 — or 4 through the wrapper),
+            # while an environment failure the supervisor healed (gloo
+            # startup race, SIGABRT) never does. Without this, a healed
+            # env flake would silently consume a schedule slot.
+            fired = _fired_faults(ctx["ledger"]["entries"])
+            faults = _plan[fired][1] if fired < len(_plan) else ""
+            return child_specs(
+                c, data, w, o, h, f"a{k}", budget, interval, faults
+            )
+
+        sup = RunSupervisor(
+            build,
+            ledger_path=os.path.join(root, "restart_ledger.json"),
+            heartbeat_path=os.path.join(obs, "heartbeat.json"),
+            target_step=budget,
+            # headroom beyond the schedule: the supervisor also heals
+            # ENVIRONMENT failures (e.g. the gloo startup race CPU CI
+            # machines occasionally hit) — that is its job, and the
+            # assertions below are written restart-count-tolerant
+            max_restarts=len(plan) + 5,
+            restart_backoff_s=args.backoff_s,
+            crash_loop_threshold=5,
+            on_slice_loss="same",  # see module docstring: identity
+            num_slices=2,
+            reset_paths=(hb,),
+            log_dir=logs,
+        )
+        t0 = time.time()
+        res = sup.run()
+        print(
+            f"{kind}: supervisor {res.status} after {res.restarts} "
+            f"restart(s) in {time.time() - t0:.0f}s"
+        )
+        assert res.status == "completed", (
+            f"{kind} soak did not complete: {res.status}\n{res.post_mortem}"
+        )
+        if kind == "faulted":
+            fired = _fired_faults(res.ledger["entries"])
+            assert fired >= len(plan), (
+                f"only {fired} fault(s) fired of {len(plan)} scheduled; "
+                f"ledger: {res.ledger}"
+            )
+
+        # committed windows per incarnation: attempt k resumed at the
+        # START_STEP its log printed; its committed prefix ends where
+        # attempt k+1 resumed (the final attempt ends at the budget)
+        starts = []
+        for k in range(len(sup.entries)):
+            s = _grab(
+                os.path.join(logs, f"attempt{k}_child0.log"), "START_STEP"
+            )
+            starts.append(int(s) if s is not None else None)
+        windows = []
+        for k, s in enumerate(starts):
+            if s is None:
+                continue  # died before restore (no committed work)
+            end = budget
+            for nxt in starts[k + 1 :]:
+                if nxt is not None:
+                    end = nxt
+                    break
+            windows.append((f"a{k}", s, end))
+        markers = effective_markers(walk, windows)
+        assert markers, f"{kind}: empty effective walk ({windows})"
+        dupes = sorted(
+            {m for m in markers if markers.count(m) > 1}
+        ) if len(markers) != len(set(markers)) else []
+        assert not dupes, (
+            f"{kind}: replayed documents in the effective stream: "
+            f"{dupes[:10]} (windows {windows})"
+        )
+
+        # hash incarnation: num_steps == budget -> restore-only, prints
+        # the topology-independent STATE_HASH of the final checkpoint
+        specs = child_specs(
+            ckpt, data, walk, obs, hb, "hash", budget, interval
+        )
+        codes = sup._launch_subprocesses(specs, len(sup.entries), "hash")
+        assert codes == [0, 0], f"{kind} hash phase failed: {codes}"
+        hash_log = os.path.join(logs, f"attempt{len(sup.entries)}_child0.log")
+        final_step = _grab(hash_log, "START_STEP")
+        state_hash = _grab(hash_log, "STATE_HASH")
+        assert final_step == str(budget), (
+            f"{kind}: final committed step {final_step} != budget {budget}"
+        )
+        rec = last_metrics_record(obs)
+        assert rec is not None, f"{kind}: no metrics.jsonl record"
+        # run-level goodput: committed work over the run's wall clock,
+        # restart downtime included. (Per-incarnation window goodput
+        # counts each incarnation's recompile as compute, so at CPU-test
+        # scale it cannot compare a restarted run against a straight
+        # one; useful output per run-wall-second can.) The FAULTED run
+        # is charged its whole run (every incarnation + downtime); the
+        # CLEAN reference rate comes from its final incarnation — a
+        # straight, uninterrupted pass — so an environment flake the
+        # supervisor healed in the clean run cannot mask the injected
+        # faults' cost.
+        entries = res.ledger["entries"]
+        run_wall = entries[-1]["ended_unix"] - entries[0]["started_unix"]
+        final_start = next(
+            (s for s in reversed(starts) if s is not None), 0
+        )
+        final_wall = entries[-1]["ended_unix"] - entries[-1]["started_unix"]
+        results[kind] = {
+            "state_hash": state_hash,
+            "restarts_metric": rec.get("restarts"),
+            "restart_downtime_s": rec.get("restart_downtime_s"),
+            "run_wall_s": run_wall,
+            "run_goodput_steps_per_s": budget / max(1e-9, run_wall),
+            "straight_steps_per_s": (budget - final_start)
+            / max(1e-9, final_wall),
+            "supervisor_restarts": res.restarts,
+            "markers": sorted(markers),
+            "ledger": res.ledger,
+        }
+
+    f, c = results["faulted"], results["clean"]
+    assert f["state_hash"] == c["state_hash"], (
+        f"end-state hash diverged: faulted {f['state_hash']} != clean "
+        f"{c['state_hash']}"
+    )
+    assert f["markers"] == c["markers"], (
+        "effective consumed stream diverged from the fault-free run "
+        f"({len(f['markers'])} vs {len(c['markers'])} markers)"
+    )
+    assert f["restarts_metric"] and f["restarts_metric"] >= len(schedule), (
+        f"metrics restarts field {f['restarts_metric']} does not reflect "
+        f"the {len(schedule)} scheduled faults"
+    )
+    assert (f["restart_downtime_s"] or 0) > 0, f
+    if c["supervisor_restarts"]:
+        # the supervisor healed a NON-injected environment failure in
+        # the reference run (e.g. a gloo startup race) — its job, and
+        # exactly why the clean goodput reference below uses the final
+        # straight incarnation rather than the whole clean run
+        print(
+            f"note: clean run needed {c['supervisor_restarts']} "
+            f"environment restart(s) (no faults were injected); "
+            f"supervisor healed them"
+        )
+    assert (
+        f["run_goodput_steps_per_s"] < c["straight_steps_per_s"]
+    ), (
+        f"faulted run goodput {f['run_goodput_steps_per_s']:.4f} steps/s "
+        f"not below the straight-run rate {c['straight_steps_per_s']:.4f} "
+        f"despite {f['restart_downtime_s']}s downtime and "
+        f"{f['supervisor_restarts']} restart(s)"
+    )
+    summary = {
+        "seed": args.seed,
+        "budget_steps": args.budget_steps,
+        "schedule": [s for s, _ in schedule],
+        "state_hash": f["state_hash"],
+        "restarts": f["supervisor_restarts"],
+        "restart_downtime_s": f["restart_downtime_s"],
+        "run_goodput_faulted_steps_per_s": f["run_goodput_steps_per_s"],
+        "straight_run_steps_per_s": c["straight_steps_per_s"],
+        "clean_env_restarts": c["supervisor_restarts"],
+        "effective_documents": len(f["markers"]),
+        "ok": True,
+    }
+    print(json.dumps(summary, indent=1))
+    return summary
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--budget-steps", type=int, default=24)
+    ap.add_argument("--ckpt-interval", type=int, default=4)
+    ap.add_argument("--sites", type=int, default=3,
+                    help="distinct fault sites to schedule (>=1; "
+                    "slice_kill always included)")
+    ap.add_argument("--backoff-s", type=float, default=0.2)
+    ap.add_argument("--workdir", default=None,
+                    help="working directory (kept); default: a temp dir, "
+                    "removed on success")
+    args = ap.parse_args(argv)
+
+    keep = args.workdir is not None
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_soak_")
+    os.makedirs(workdir, exist_ok=True)
+    print(f"chaos soak workdir: {workdir}")
+    try:
+        run_soak(args, workdir)
+    except AssertionError as e:
+        print(f"CHAOS SOAK FAILED: {e}", file=sys.stderr)
+        print(f"(workdir kept for post-mortem: {workdir})", file=sys.stderr)
+        return 1
+    if not keep:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -174,7 +174,7 @@ def main(**kwargs):
     feed = DeviceFeed(
         rebatch(train_loader, local_batch, cfg.batch_size),
         mesh,
-        prefetch=2,
+        prefetch=max(0, int(getattr(cfg, "feed_prefetch", 2))),
         registry=observer.registry,
     )
 
@@ -223,4 +223,9 @@ def main(**kwargs):
 
 
 if __name__ == "__main__":
-    main(**parse_cli_args(sys.argv[1:]))
+    # classified-exit mapping for the self-healing supervisor, same as
+    # the pretraining entries (resilience/exits.py)
+    from fms_fsdp_tpu.resilience.exits import classified_exit
+
+    with classified_exit():
+        main(**parse_cli_args(sys.argv[1:]))

@@ -65,7 +65,13 @@ def _walk_logged(feed, walk_path):
     """Yield from the device feed, logging every doc-marker token of the
     rows this process holds (its addressable shards) for each batch the
     train loop consumes. Rows reconstruct the packed line exactly:
-    input + label[-1] (causal_lm: input = line[:-1], label = line[1:])."""
+    input + label[-1] (causal_lm: input = line[:-1], label = line[1:]).
+
+    A ``B`` separator line precedes each batch's markers: one pulled
+    batch == one trainer step, so a reader can truncate a killed
+    incarnation's walk to its committed prefix (the chaos-soak driver's
+    effective-stream reconstruction, scripts/chaos_soak.py). Marker
+    consumers skip the non-numeric lines."""
     with open(walk_path, "a") as f:
         for batch in feed:
             x, y = batch
@@ -74,6 +80,7 @@ def _walk_logged(feed, walk_path):
                 seen[str(xs.index)] = (
                     np.asarray(xs.data), np.asarray(ys.data)
                 )
+            f.write("B\n")
             for xr, yr in seen.values():
                 full = np.concatenate([xr, yr[:, -1:]], axis=1)
                 for m in full[full >= MARKER_BASE]:
@@ -192,9 +199,12 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
     state, _ = init_train_state(
         jax.random.PRNGKey(cfg.seed), model_cfg, cfg, mesh, optimizer
     )
+    # the loader rides along (same as main_training_llama): it must
+    # restore from the SAME resolved checkpoint dir as the model, not
+    # from a possibly-ahead loader auto-save
     state, _, start_step, tokens_seen, is_resuming = checkpointer.load(
         state,
-        None,
+        loader,
         path=os.path.join(cfg.ckpt_load_path, "checkpoints/"),
         strict=False,
     )
@@ -215,7 +225,9 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
     if num_steps > start_step:
         step_fn = make_train_step(model_cfg, cfg, mesh, optimizer)
         feed = DeviceFeed(
-            rebatch(loader, local_batch, cfg.batch_size), mesh, prefetch=2
+            rebatch(loader, local_batch, cfg.batch_size),
+            mesh,
+            prefetch=max(0, int(getattr(cfg, "feed_prefetch", 2))),
         )
         walk_path = os.path.join(walk_dir, f"walk_{phase}_rank{rank}.txt")
         os.makedirs(walk_dir, exist_ok=True)
@@ -247,13 +259,18 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
 
 
 if __name__ == "__main__":
-    run(
-        sys.argv[1],
-        sys.argv[2],
-        sys.argv[3],
-        sys.argv[4],
-        int(sys.argv[5]),
-        int(sys.argv[6]),
-        sys.argv[7] if len(sys.argv) > 7 else "",
-        sys.argv[8:],
-    )
+    # classified-exit mapping, exactly like the production entries: the
+    # supervisor e2e and chaos soak classify this child's exits
+    from fms_fsdp_tpu.resilience.exits import classified_exit
+
+    with classified_exit():
+        run(
+            sys.argv[1],
+            sys.argv[2],
+            sys.argv[3],
+            sys.argv[4],
+            int(sys.argv[5]),
+            int(sys.argv[6]),
+            sys.argv[7] if len(sys.argv) > 7 else "",
+            sys.argv[8:],
+        )
