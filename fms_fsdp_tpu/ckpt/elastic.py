@@ -36,7 +36,7 @@ import json
 import os
 from typing import Dict, List, Optional, Tuple
 
-TOPOLOGY_VERSION = 2
+TOPOLOGY_VERSION = 3
 
 # name -> type tag. The topology fingerprint stamped into every
 # checkpoint's metadata.json (key "topology"). ``loader_files`` is the
@@ -52,6 +52,16 @@ TOPOLOGY_VERSION = 2
 # comes back in different slice sizes must restart single-slice or
 # matching. Old (v1) fingerprints lack the fields; they load with a
 # note and skip the slice checks.
+#
+# v3 adds the data-mix dims (weighted multi-corpus mixing,
+# data/streaming.py SamplingDataset): ``corpus_names`` is the comma-
+# joined corpus list in config order ("" for dummy-data runs) and
+# ``mix_weights_digest`` a digest of the normalized weight vector.
+# ``check_rescale`` gates corpus-SET changes (per-corpus mix state pairs
+# by name and cannot follow added/removed corpora without
+# ``allow_corpus_change``) while weight changes and pure reorders stay
+# legal with a note (``describe_mixing_change``). Pre-v3 fingerprints
+# lack the fields and skip the mixing checks.
 TOPOLOGY_FIELDS = {
     "process_count": "int",
     "device_count": "int",
@@ -64,6 +74,8 @@ TOPOLOGY_FIELDS = {
     "num_slices": "int",
     "slice_process_count": "int",
     "slice_device_count": "int",
+    "corpus_names": "str",
+    "mix_weights_digest": "str",
 }
 
 # Digest of the canonical field serialization per published version; a
@@ -74,6 +86,9 @@ TOPOLOGY_DIGESTS = {
     # v2: + num_slices / slice_process_count / slice_device_count (the
     # multi-slice fault-domain dims)
     2: "41468023883ed0cf352f1e808cef04a5b5788ecb5f44d8d033773ec6ba2b66fe",
+    # v3: + corpus_names / mix_weights_digest (the weighted multi-corpus
+    # mix joins the elastic contract)
+    3: "ed18d2b2c9ee9fb0efbe627f52a36d77a96b44ccad180430c905df9772de179c",
 }
 
 
@@ -96,6 +111,32 @@ def data_parallel_rows_extent(cfg, device_count: int) -> int:
     return max(1, device_count // tp // cp)
 
 
+def _split_names(joined: str) -> List[str]:
+    return [n for n in str(joined or "").split(",") if n]
+
+
+def mixing_fingerprint(cfg) -> Tuple[str, str]:
+    """The data-mix dims of the fingerprint: (comma-joined corpus names
+    in config order, digest of the normalized weight vector). Dummy-data
+    runs (no stateful loader) fingerprint as ("", "") and skip every
+    mixing check."""
+    if bool(getattr(cfg, "use_dummy_dataset", False)):
+        return "", ""
+    from fms_fsdp_tpu.data.loader import parse_data_args
+
+    try:
+        datasets, weights = parse_data_args(
+            getattr(cfg, "datasets", ""), getattr(cfg, "weights", "1")
+        )
+    except (ValueError, TypeError):
+        return "", ""
+    total = float(sum(weights)) or 1.0
+    canon = json.dumps(
+        [round(w / total, 12) for w in weights], separators=(",", ":")
+    )
+    return ",".join(datasets), hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
 def current_fingerprint(
     cfg, process_count: Optional[int] = None, device_count: Optional[int] = None
 ) -> Dict[str, int]:
@@ -115,6 +156,7 @@ def current_fingerprint(
     workers = max(1, int(getattr(cfg, "num_workers", 1) or 1))
     n_slices, _ = process_slice_context(cfg)
     n_slices = max(1, int(n_slices))
+    corpus_names, weights_digest = mixing_fingerprint(cfg)
     return {
         "process_count": pc,
         "device_count": dc,
@@ -134,6 +176,11 @@ def current_fingerprint(
         "num_slices": n_slices,
         "slice_process_count": max(1, pc // n_slices),
         "slice_device_count": max(1, dc // n_slices),
+        # v3 data-mix dims: per-corpus resume state pairs by NAME, so
+        # the corpus set is part of the elastic contract; the weights
+        # digest makes a (legal) weight change visible at the gate
+        "corpus_names": corpus_names,
+        "mix_weights_digest": weights_digest,
     }
 
 
@@ -175,11 +222,38 @@ def _count_loader_files(ckp_dir: str) -> int:
         return 0
 
 
+def describe_mixing_change(old: Dict, new: Dict) -> Optional[str]:
+    """Human note for LEGAL data-mix changes across a resume (printed by
+    the load gate), or None when the mix is unchanged / unfingerprinted.
+    Corpus-SET changes are not described here — they are gated as
+    problems by ``check_rescale`` unless ``allow_corpus_change``."""
+    old_names = _split_names(old.get("corpus_names"))
+    new_names = _split_names(new.get("corpus_names"))
+    if not old_names or not new_names:
+        return None
+    notes = []
+    if old_names != new_names and set(old_names) == set(new_names):
+        notes.append(
+            "corpus order changed (harmless: per-corpus mix state pairs "
+            "by name, not index)"
+        )
+    old_d = str(old.get("mix_weights_digest") or "")
+    new_d = str(new.get("mix_weights_digest") or "")
+    if old_d and new_d and old_d != new_d:
+        notes.append(
+            "mixing weights changed: the token-share controller steers "
+            "toward the new targets from here (no stream position is "
+            "lost)"
+        )
+    return "; ".join(notes) or None
+
+
 def check_rescale(
     old: Dict,
     new: Dict,
     ckp_dir: Optional[str] = None,
     allow_batch_change: bool = False,
+    allow_corpus_change: bool = False,
 ) -> Tuple[List[str], bool]:
     """Validate that the ``new`` world may consume a checkpoint stamped
     with ``old``. Returns ``(problems, changed)`` — ``problems`` is a
@@ -228,6 +302,30 @@ def check_rescale(
                     f"slice count), or restart as a single slice "
                     f"(--num_slices=1) to rescale freely"
                 )
+
+    # Data-mix legality (v3, docs/dataloader.md "Multi-corpus mixing"):
+    # per-corpus resume state pairs by NAME, so a changed corpus SET
+    # (added/removed/renamed) cannot silently misassign another corpus's
+    # walk position — it is gated behind allow_corpus_change. A pure
+    # reorder or a weight change is legal (the gate prints the
+    # describe_mixing_change note). Pre-v3 fingerprints carry no mix
+    # fields and skip this block.
+    old_corpora = _split_names(old.get("corpus_names"))
+    new_corpora = _split_names(new.get("corpus_names"))
+    if old_corpora and new_corpora and set(old_corpora) != set(new_corpora):
+        if not allow_corpus_change:
+            added = [n for n in new_corpora if n not in old_corpora]
+            removed = [n for n in old_corpora if n not in new_corpora]
+            problems.append(
+                f"the corpus set changed across the resume (added: "
+                f"{added or 'none'}, removed: {removed or 'none'}): "
+                f"per-corpus mix state pairs by name and cannot follow "
+                f"a changed set. Restart with "
+                f"--datasets={','.join(old_corpora)}, or pass "
+                f"--allow_corpus_change=True to accept it (removed "
+                f"corpora drop their stream position; new corpora start "
+                f"cold)"
+            )
 
     old_logical = int(old.get("n_logical_shards") or 0)
     new_logical = int(new.get("n_logical_shards") or 0)

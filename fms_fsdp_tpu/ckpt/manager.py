@@ -141,12 +141,19 @@ class AsyncCheckpointManager:
         # enforced by the tier Checkpointers' load gate
         self.fingerprint: dict = None
 
-    def set_fingerprint(self, fingerprint, allow_batch_change: bool = False):
+    def set_fingerprint(
+        self,
+        fingerprint,
+        allow_batch_change: bool = False,
+        allow_corpus_change: bool = False,
+    ):
         """Arm the elastic-resume contract on every tier (see
         ``Checkpointer.set_fingerprint``)."""
         self.fingerprint = dict(fingerprint) if fingerprint else None
         for tier in self.tiers:
-            tier.ckp.set_fingerprint(fingerprint, allow_batch_change)
+            tier.ckp.set_fingerprint(
+                fingerprint, allow_batch_change, allow_corpus_change
+            )
 
     def resume_topology(self):
         """Topology fingerprint of the newest committed checkpoint a
@@ -570,5 +577,6 @@ def build_checkpoint_manager(
     mgr.set_fingerprint(
         current_fingerprint(cfg),
         allow_batch_change=bool(getattr(cfg, "allow_batch_change", False)),
+        allow_corpus_change=bool(getattr(cfg, "allow_corpus_change", False)),
     )
     return mgr

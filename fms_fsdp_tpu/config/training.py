@@ -26,6 +26,19 @@ class TrainConfig:
     tokenizer_path: str = "/tmp/tokenizer"
     datasets: str = "dataset=commoncrawl"
     weights: str = "1"
+    # Multi-corpus fault isolation (docs/dataloader.md "Multi-corpus
+    # mixing"): when every owned shard of one corpus dies, the corpus is
+    # quarantined and the mix degrades gracefully (weights renormalized
+    # over survivors, survivor epoch boundaries re-probe it) as long as
+    # at least this many corpora stay live; dropping below the floor —
+    # losing the last corpus always does — exits with the classified
+    # ``corpus_loss`` code the run supervisor restarts on.
+    min_live_corpora: int = 1
+    # Resume-state pairing is by corpus NAME; a changed corpus set
+    # (added/removed/renamed vs the checkpoint) is a hard error unless
+    # this escape hatch accepts it (removed corpora drop their stream
+    # position, new corpora start cold at zero tokens_seen).
+    allow_corpus_change: bool = False
     seq_length: int = 4096
     vocab_size: int = 32000
     bos_token: Optional[int] = None

@@ -10,10 +10,13 @@ from fms_fsdp_tpu.data.loader import (
     causal_lm,
     get_data_loader,
     get_dummy_loader,
+    loader_mix_stats,
     parse_data_args,
 )
 from fms_fsdp_tpu.data.stateful import StatefulDataset, WrapperDataset
 from fms_fsdp_tpu.data.streaming import (
+    CorpusLossError,
+    CorpusUnreadableError,
     SamplingDataset,
     ScalableShardDataset,
     StreamingDocDataset,
@@ -25,6 +28,8 @@ __all__ = [
     "ParquetHandler",
     "BufferDataset",
     "CheckpointDataset",
+    "CorpusLossError",
+    "CorpusUnreadableError",
     "PreloadBufferDataset",
     "PreprocessDataset",
     "SamplingDataset",
@@ -36,5 +41,6 @@ __all__ = [
     "causal_lm",
     "get_data_loader",
     "get_dummy_loader",
+    "loader_mix_stats",
     "parse_data_args",
 ]

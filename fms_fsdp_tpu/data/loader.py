@@ -48,6 +48,7 @@ from fms_fsdp_tpu.data.buffering import (
 )
 from fms_fsdp_tpu.data.handlers import ArrowHandler, AutoHandler, ParquetHandler
 from fms_fsdp_tpu.data.streaming import (
+    CorpusLossError,
     SamplingDataset,
     ScalableShardDataset,
     StreamingDocDataset,
@@ -490,7 +491,11 @@ class StatefulDataLoader:
                     t.start()
                     continue
                 self.shutdown()
-                if isinstance(batch, StopIteration):
+                if isinstance(batch, (StopIteration, CorpusLossError)):
+                    # CorpusLossError stays typed: the entry wrapper
+                    # exits corpus_loss, not loader_death — the
+                    # supervisor restarts dead DATA differently from a
+                    # dead worker
                     raise batch
                 # restart budget exhausted: surface typed so the entry's
                 # classified-exit wrapper exits loader_death (the
@@ -505,10 +510,12 @@ class StatefulDataLoader:
 
     def _can_restart(self, err, restarts, w) -> bool:
         """Worker-restart budget check + backoff sleep. StopIteration
-        (stream genuinely ended) is never restarted; anything else gets
+        (stream genuinely ended) and CorpusLossError (the data itself is
+        gone below the survivable floor — a worker restart rereads the
+        same dead corpora) are never restarted; anything else gets
         ``max_worker_restarts`` attempts per worker per generation with
         exponential backoff before the error surfaces to the consumer."""
-        if isinstance(err, StopIteration):
+        if isinstance(err, (StopIteration, CorpusLossError)):
             return False
         if restarts[w] >= self.max_worker_restarts:
             return False
@@ -641,7 +648,7 @@ class StatefulDataLoader:
                     self._spawn_proc_worker(w, ctx, queues)
                     continue
                 self.shutdown()
-                if isinstance(batch, StopIteration):
+                if isinstance(batch, (StopIteration, CorpusLossError)):
                     raise batch
                 raise LoaderWorkerError(
                     f"loader worker {w} failed and the restart budget "
@@ -967,6 +974,11 @@ def get_data_loader(cfg, rank, world_size, postprocess=None, batch_multiplier=1)
         cfg.eos_token,
         datasets=datasets,
         weights=weights,
+        # fault-isolation floor: a run survives corpus loss (weights
+        # renormalized over survivors) down to this many live corpora;
+        # below it the classified corpus_loss exit fires
+        min_live_corpora=int(getattr(cfg, "min_live_corpora", 1) or 1),
+        allow_corpus_change=bool(getattr(cfg, "allow_corpus_change", False)),
         verbose=(rank == 0),
     )
     # +1 token so the causal shift still yields seq_length-long examples
@@ -1049,6 +1061,57 @@ def rebatch(loader, local_batch: int, batch_size: int):
                 yield np.concatenate(parts)
 
     return gen()
+
+
+def _find_layer(pipeline, cls):
+    """Walk a wrapper pipeline's ``.dataset`` chain for a layer type."""
+    d = pipeline
+    while d is not None:
+        if isinstance(d, cls):
+            return d
+        d = getattr(d, "dataset", None)
+    return None
+
+
+def loader_mix_stats(loader):
+    """Aggregate per-corpus mixing stats from a live loader, or None.
+
+    Walks every worker pipeline's wrapper chain to the SamplingDataset
+    and sums per-corpus ``tokens_seen`` (racy int reads — gauge
+    accuracy, not exactness). Returns ``{"tokens": {corpus: int},
+    "weights": {corpus: float}, "quarantined": [corpus, ...]}``.
+    None when the loader carries no mixing layer (dummy loader), the
+    pipeline is not set up yet (fresh un-iterated start), or
+    worker_mode="process" has started its workers (the parent's
+    pipeline copies never advance — their numbers would be frozen at
+    the fork point)."""
+    pipelines = getattr(loader, "pipelines", None)
+    if not pipelines:
+        return None
+    if (
+        getattr(loader, "worker_mode", "thread") == "process"
+        and getattr(loader, "_procs_started", False)
+    ):
+        return None
+    samplers = [
+        s
+        for s in (_find_layer(p, SamplingDataset) for p in pipelines)
+        if s is not None and s.is_setup
+    ]
+    if not samplers:
+        return None
+    names = list(samplers[0].datasets)
+    tokens = {n: 0 for n in names}
+    quarantined = set()
+    for s in samplers:
+        for n, t in zip(s.datasets, s.tokens_seen):
+            tokens[n] = tokens.get(n, 0) + int(t)
+        quarantined.update(s.quarantined_corpora)
+    return {
+        "tokens": tokens,
+        "weights": {n: float(w) for n, w in zip(names, samplers[0].weights)},
+        "quarantined": sorted(quarantined),
+    }
 
 
 def parse_data_args(datas, weights):

@@ -38,6 +38,46 @@ from fms_fsdp_tpu.data.stateful import (
 logger = logging.getLogger(__name__)
 
 
+class CorpusUnreadableError(RuntimeError):
+    """One corpus's document stream died: every owned shard of the
+    corpus is quarantined (or the corpus held no readable documents to
+    begin with). Raised by the per-corpus reader stack and caught by
+    ``SamplingDataset``, which quarantines the corpus and degrades the
+    mix over the survivors instead of killing the run."""
+
+
+class CorpusLossError(RuntimeError):
+    """The weighted mix dropped below its survivable floor: losing a
+    corpus left fewer than ``min_live_corpora`` live corpora (losing the
+    LAST corpus always breaches the implicit floor of 1). Typed so the
+    entry points' classified-exit wrapper (resilience/exits.py) exits
+    with the ``corpus_loss`` registry code and the run supervisor
+    applies the corpus-loss restart policy rather than the generic
+    crash policy."""
+
+
+# Mix lifecycle events buffered for the observer (obs/): the
+# SamplingDataset lives deep inside the loader pipeline — possibly in a
+# worker thread — with no registry handle, so it bumps these module
+# counters (GIL-atomic int +=) and the train loop drains them into the
+# metric registry at report cadence (``data.corpus_quarantined`` /
+# ``data.corpus_rearmed``). Forked process-mode workers keep their own
+# copy; their events are visible in logs but not in the parent's
+# metrics (docs/dataloader.md "Multi-corpus mixing").
+_MIX_EVENTS = {"corpus_quarantined": 0, "corpus_rearmed": 0}
+
+
+def drain_mix_events() -> dict:
+    """Return and consume the buffered mix lifecycle events. Decrements
+    by the drained amount rather than resetting to zero: a worker-thread
+    increment landing between the copy and the reset must not be
+    silently discarded (it stays buffered for the next drain)."""
+    out = dict(_MIX_EVENTS)
+    for k, n in out.items():
+        _MIX_EVENTS[k] -= n
+    return out
+
+
 class StreamingDocDataset(StatefulDataset):
     """Base reader for one dataset directory (need not be flat).
 
@@ -307,7 +347,11 @@ class StreamingDocDataset(StatefulDataset):
             )
         owned = set(s for s, _, _ in self.docset)
         if owned and owned.issubset(set(self.quarantined_shards)):
-            raise RuntimeError(
+            # typed: under a SamplingDataset this degrades the MIX
+            # (corpus quarantined, weights renormalized over survivors)
+            # instead of killing the run; a single-corpus pipeline still
+            # surfaces it fatally
+            raise CorpusUnreadableError(
                 f"worker {self.rank}: all {len(owned)} owned shards are "
                 f"quarantined; no readable data remains"
             ) from err
@@ -322,7 +366,7 @@ class StreamingDocDataset(StatefulDataset):
         residual_chunks = self.chunk_index + 1
         ndocs = self._len
         if ndocs == 0:
-            raise RuntimeError(
+            raise CorpusUnreadableError(
                 f"worker {self.rank}: no readable documents in "
                 f"{self.datapath}"
                 + (
@@ -631,7 +675,27 @@ class ScalableShardDataset(WrapperDataset):
 class SamplingDataset(WrapperDataset):
     """Multi-dataset weighted mixing by tokens seen: each draw picks the
     subdataset furthest below its target share and holds it through a full
-    document (delimiter detection)."""
+    document (delimiter detection).
+
+    Production hardening (docs/dataloader.md "Multi-corpus mixing"):
+
+    - resume state pairs subdatasets by corpus NAME, not list index —
+      adding/reordering a corpus cannot silently misassign another
+      corpus's walk position; a changed corpus SET is an actionable
+      error unless ``allow_corpus_change`` accepts it;
+    - corpus-granular fault isolation: when a corpus's whole reader
+      stack dies (``CorpusUnreadableError`` — every owned shard
+      quarantined), the corpus is quarantined and the mix degrades
+      gracefully (weights renormalized over survivors) instead of
+      killing the run; survivor epoch boundaries re-arm a quarantined
+      corpus. Dropping below ``min_live_corpora`` live corpora (or
+      losing the last corpus) raises ``CorpusLossError``, which the
+      entry points classify as the ``corpus_loss`` supervisor exit;
+    - a max-held-chunks guard releases the document hold if a
+      subdataset emits chunks whose last token never equals the
+      delimiter (zero-length/undelimited tail documents previously
+      pinned ``current_iterator`` forever, starving every other corpus).
+    """
 
     def __init__(
         self,
@@ -640,22 +704,32 @@ class SamplingDataset(WrapperDataset):
         delimiter_token: Any,
         datasets=None,
         weights=None,
+        min_live_corpora: int = 1,
+        allow_corpus_change: bool = False,
+        max_held_chunks: int = 4096,
         verbose=False,
     ):
         super().__init__(dataset)
         self.datapath = datapath
         self.delimiter = delimiter_token
         self.verbose = verbose
+        # auto-discovery is SORTED: os.listdir order is filesystem-
+        # dependent, and different ranks/hosts disagreeing on corpus
+        # order would diverge the mix (and misassign per-index state)
         self.datasets = (
-            datasets
+            list(datasets)
             if datasets is not None
-            else [
+            else sorted(
                 f
                 for f in os.listdir(datapath)
                 if not os.path.isfile(os.path.join(datapath, f)) and "meta" not in f
-            ]
+            )
         )
         assert len(self.datasets) > 0, "You must specify at least one dataset"
+        assert len(set(self.datasets)) == len(self.datasets), (
+            f"Duplicate corpus names in {self.datasets}: resume state "
+            f"pairs by name and requires unique names"
+        )
 
         if weights is not None:
             assert len(weights) == len(self.datasets), (
@@ -667,9 +741,28 @@ class SamplingDataset(WrapperDataset):
         self.weights = [1] * len(self.datasets) if weights is None else weights
         self.weights = [w / sum(self.weights) for w in self.weights]
 
+        self.min_live_corpora = max(1, int(min_live_corpora))
+        self.allow_corpus_change = bool(allow_corpus_change)
+        self.max_held_chunks = max(1, int(max_held_chunks))
+
         self.tokens_seen = [0] * len(self.datasets)
         self.current_iterator = -1
-        self.state_params = ["tokens_seen", "current_iterator"]
+        # corpora whose reader stack died (by NAME); persisted so a
+        # resume knows the mix was degraded — the iterator re-probes
+        # them at start and at survivor epoch boundaries
+        self.quarantined_corpora: List[str] = []
+        self.state_params = [
+            "tokens_seen",
+            "current_iterator",
+            "quarantined_corpora",
+        ]
+        # survivor epoch clock at quarantine time (name -> clock); None
+        # = eligible for an immediate re-probe (fresh iterator /
+        # resume). Not persisted: a restart is a natural re-probe point.
+        self._rearm_snapshot: dict = {}
+        self._held_chunks = 0
+        self._starve_warned: Set[str] = set()
+        self._pending = None  # (corpus index, first chunk) from a re-arm
 
     def setup(self):
         if self.is_setup:
@@ -691,50 +784,352 @@ class SamplingDataset(WrapperDataset):
         for d in self.data:
             d.setup()
 
+    # -- fault isolation ---------------------------------------------------
+
+    def _live_indices(self) -> List[int]:
+        return [
+            i
+            for i, n in enumerate(self.datasets)
+            if n not in self.quarantined_corpora
+        ]
+
+    def _survivor_epochs(self) -> int:
+        """Monotonic epoch clock over the LIVE corpora: advances as their
+        readers wrap epochs (per logical shard under
+        ScalableShardDataset). Quarantined corpora re-probe when this
+        clock has advanced past their quarantine snapshot — the corpus-
+        level analog of the shard-level epoch-boundary re-probe."""
+        total = 0
+        for i in self._live_indices():
+            sub = self.data[i]
+            readers = getattr(sub, "data", None)
+            if isinstance(readers, list) and readers:
+                total += sum(getattr(r, "epochs_seen", 0) for r in readers)
+            else:
+                total += getattr(sub, "epochs_seen", 0)
+        return total
+
+    def _injected_kill(self, i: int) -> bool:
+        """``corpus_kill`` fault site (resilience/faults.py): simulates
+        every owned shard of one corpus dying at once. Filter:
+        ``corpus=`` (substring). Consulted at document boundaries and
+        re-probe attempts; production runs never fire it."""
+        from fms_fsdp_tpu.resilience.faults import fire_fault
+
+        return fire_fault("corpus_kill", corpus=self.datasets[i]) is not None
+
+    def _quarantine_corpus(self, i: int, err) -> None:
+        """Quarantine corpus ``i``: the mix degrades to the survivors
+        with weights renormalized, or — below the ``min_live_corpora``
+        floor — raises the classified ``CorpusLossError``."""
+        name = self.datasets[i]
+        if name not in self.quarantined_corpora:
+            self.quarantined_corpora.append(name)
+            self._rearm_snapshot[name] = self._survivor_epochs()
+            _MIX_EVENTS["corpus_quarantined"] += 1
+        live = self._live_indices()
+        if len(live) < self.min_live_corpora:
+            raise CorpusLossError(
+                f"worker {self.rank}: corpus {name!r} is unreadable and "
+                f"only {len(live)} of {len(self.datasets)} corpora remain "
+                f"live — below min_live_corpora={self.min_live_corpora} "
+                f"(quarantined: {self.quarantined_corpora}). Restore the "
+                f"corpus data and restart (the supervisor classifies "
+                f"this exit as corpus_loss), or lower --min_live_corpora "
+                f"to accept training on the surviving mix."
+            ) from err
+        wsum = sum(self.weights[j] for j in live)
+        renorm = {
+            self.datasets[j]: round(self.weights[j] / wsum, 4) for j in live
+        }
+        logger.error(
+            "worker %d: corpus %r quarantined (%s); mix degrades to %d "
+            "live corpora with weights renormalized over survivors: %s "
+            "— survivor epoch boundaries re-probe and re-arm it if it "
+            "heals",
+            self.rank,
+            name,
+            err,
+            len(live),
+            renorm,
+        )
+
+    def _maybe_rearm(self, data) -> None:
+        """Re-probe quarantined corpora whose snapshot the survivor
+        epoch clock has passed (at most one re-arm per document
+        boundary). A successful probe pulls the corpus's next chunk —
+        stashed in ``_pending`` and served immediately, so the probe
+        never skips data."""
+        if not self.quarantined_corpora:
+            return
+        clock = self._survivor_epochs()
+        for name in list(self.quarantined_corpora):
+            snap = self._rearm_snapshot.get(name)
+            if snap is not None and clock <= snap:
+                continue
+            i = self.datasets.index(name)
+            if self._injected_kill(i):
+                self._rearm_snapshot[name] = clock
+                continue
+            it = iter(self.data[i])
+            try:
+                out = next(it)
+            except CorpusUnreadableError:
+                self._rearm_snapshot[name] = clock
+                continue
+            data[i] = it
+            self.quarantined_corpora.remove(name)
+            self._rearm_snapshot.pop(name, None)
+            _MIX_EVENTS["corpus_rearmed"] += 1
+            logger.info(
+                "worker %d: corpus %r healed; re-armed into the mix "
+                "(weights restored to their configured shares)",
+                self.rank,
+                name,
+            )
+            self._pending = (i, out)
+            return
+
+    def _select_corpus(self) -> int:
+        """Most-undertarget LIVE subdataset next (ties -> higher index),
+        with weights renormalized over the live set."""
+        while True:
+            live = self._live_indices()
+            total = sum(self.tokens_seen[j] for j in live) + 1e-9
+            wsum = sum(self.weights[j] for j in live)
+            choice = max(
+                (self.weights[j] / wsum - self.tokens_seen[j] / total, j)
+                for j in live
+            )[1]
+            if self._injected_kill(choice):
+                self._quarantine_corpus(
+                    choice,
+                    CorpusUnreadableError(
+                        f"injected corpus_kill: {self.datasets[choice]}"
+                    ),
+                )
+                continue
+            return choice
+
     def __iter__(self):
         self.setup()
         data = [iter(d) for d in self.data]
+        self._held_chunks = 0
+        self._pending = None
+        # restored quarantine: eligible for an immediate re-probe (a
+        # restart is a natural heal point)
+        for name in self.quarantined_corpora:
+            self._rearm_snapshot.setdefault(name, None)
         while True:
-            if self.current_iterator != -1:
-                # continue the current document
-                out = next(data[self.current_iterator])
-                self.tokens_seen[self.current_iterator] += len(out)
-                if out[-1] == self.delimiter:
-                    self.current_iterator = -1
-                yield out
+            out = None
+            if self.current_iterator == -1:
+                # document boundary: re-probe quarantined corpora, then
+                # pick the most-undertarget live subdataset
+                self._maybe_rearm(data)
+                if self._pending is not None:
+                    i, out = self._pending
+                    self._pending = None
+                else:
+                    i = self._select_corpus()
+                self.current_iterator = i
             else:
-                # most-undertarget subdataset next (ties -> higher index)
-                total = sum(self.tokens_seen) + 1e-9
-                offset = [
-                    self.weights[i] - self.tokens_seen[i] / total
-                    for i in range(len(self.datasets))
-                ]
-                self.current_iterator = max(
-                    (diff, i) for i, diff in enumerate(offset)
-                )[1]
+                i = self.current_iterator
+            if out is None:
+                try:
+                    out = next(data[i])
+                except CorpusUnreadableError as e:
+                    # the corpus's reader stack is dead: quarantine it
+                    # (or raise CorpusLossError below the floor) and
+                    # release any mid-document hold — the partial
+                    # document is lost with its corpus
+                    self._quarantine_corpus(i, e)
+                    self.current_iterator = -1
+                    self._held_chunks = 0
+                    continue
+            self.tokens_seen[i] += len(out)
+            self._held_chunks += 1
+            if out[-1] == self.delimiter:
+                self.current_iterator = -1
+                self._held_chunks = 0
+            elif self._held_chunks >= self.max_held_chunks:
+                # starvation guard: a chunk stream that never closes
+                # with the delimiter (zero-length/undelimited tail
+                # document, or a delimiter mismatch between pipeline
+                # layers) would otherwise pin current_iterator forever
+                # and starve every other corpus
+                name = self.datasets[i]
+                if name not in self._starve_warned:
+                    self._starve_warned.add(name)
+                    logger.warning(
+                        "worker %d: corpus %r emitted %d chunks without "
+                        "a document delimiter (%r); releasing the "
+                        "document hold so other corpora keep serving — "
+                        "check the corpus's delimiter/eos configuration",
+                        self.rank,
+                        name,
+                        self._held_chunks,
+                        self.delimiter,
+                    )
+                self.current_iterator = -1
+                self._held_chunks = 0
+            yield out
+
+    # -- state (keyed by corpus name) --------------------------------------
 
     def state_dict(self):
         self.setup()
         out = {
             self.statename("sample_iterator_states"): [
                 d.state_dict() for d in self.data
-            ]
+            ],
+            # the pairing key for resume: state follows the corpus NAME,
+            # never the config-list index
+            self.statename("corpus_names"): list(self.datasets),
+            self.statename("mix_weights"): list(self.weights),
         }
         out.update(StatefulDataset.state_dict(self))
         return out
+
+    def _pair_by_name(self, saved_names: List[str]) -> dict:
+        """live index -> saved index for corpora present in both; gate
+        corpus-set changes behind ``allow_corpus_change``."""
+        added = [n for n in self.datasets if n not in saved_names]
+        removed = [n for n in saved_names if n not in self.datasets]
+        if (added or removed) and not self.allow_corpus_change:
+            raise RuntimeError(
+                f"worker {self.rank}: the corpus set changed across the "
+                f"resume — checkpoint has {saved_names}, this run mixes "
+                f"{self.datasets} (added: {added or 'none'}, removed: "
+                f"{removed or 'none'}). Per-corpus mix state pairs by "
+                f"name and cannot follow a changed set. Restart with "
+                f"--datasets={','.join(saved_names)}, or pass "
+                f"--allow_corpus_change=True to accept it (removed "
+                f"corpora drop their stream position; new corpora start "
+                f"cold at zero tokens_seen)."
+            )
+        if added or removed:
+            logger.warning(
+                "worker %d: resuming across a corpus-set change "
+                "(allow_corpus_change=True): added %s start cold, "
+                "removed %s drop their stream position",
+                self.rank,
+                added or "none",
+                removed or "none",
+            )
+        return {
+            li: saved_names.index(n)
+            for li, n in enumerate(self.datasets)
+            if n in saved_names
+        }
 
     def load_state_dict(self, state_dicts, sharded_input=False):
         self.setup()
         sharded_dicts = StatefulDataset.load_state_dict(
             self, state_dicts, sharded_input
         )
-        for i, subdata in enumerate(self.data):
+        states_key = self.statename("sample_iterator_states")
+        names_key = self.statename("corpus_names")
+        saved_names = sharded_dicts[0].get(names_key)
+        legacy = saved_names is None
+        if legacy:
+            # pre-name-keyed checkpoint: index pairing is all there is,
+            # and it is only sound when the corpus COUNT matches
+            if any(
+                len(sd.get(states_key, [])) != len(self.data)
+                for sd in sharded_dicts
+            ):
+                raise RuntimeError(
+                    f"worker {self.rank}: legacy (un-named) mix state "
+                    f"holds a different corpus count than this run's "
+                    f"{len(self.data)} — index pairing would misassign "
+                    f"corpus state. Restart with the save-time "
+                    f"--datasets list."
+                )
+            logger.warning(
+                "worker %d: mix state predates name-keyed resume; "
+                "pairing %d corpora by index — verify the --datasets "
+                "order matches the save",
+                self.rank,
+                len(self.data),
+            )
+            saved_names = list(self.datasets)
+        pair = self._pair_by_name(list(saved_names))
+
+        saved_weights = sharded_dicts[0].get(self.statename("mix_weights"))
+        if saved_weights is not None and any(
+            si < len(saved_weights)
+            and abs(float(saved_weights[si]) - float(self.weights[li])) > 1e-9
+            for li, si in pair.items()
+        ):
+            # a weight change is LEGAL (docs/dataloader.md): the token-
+            # share controller simply steers toward the new targets —
+            # but say so, because the realized mix shifts from here
+            logger.info(
+                "worker %d: mixing weights changed across the resume "
+                "(saved %s -> live %s); the token-share controller "
+                "steers toward the new targets from here, no stream "
+                "position is lost",
+                self.rank,
+                [round(float(w), 4) for w in saved_weights],
+                [round(float(w), 4) for w in self.weights],
+            )
+
+        same_size = self.load_worldsize == self.worldsize
+        if same_size:
+            # the base class restored the scalar state in SAVED order;
+            # remap it onto the live corpus order by name
+            saved_tokens = list(self.tokens_seen)
+            saved_current = self.current_iterator
+            saved_quarantined = list(self.quarantined_corpora or [])
+            self.tokens_seen = [
+                (
+                    saved_tokens[pair[li]]
+                    if li in pair and pair[li] < len(saved_tokens)
+                    else 0
+                )
+                for li in range(len(self.datasets))
+            ]
+            self.current_iterator = -1
+            if saved_current is not None and 0 <= saved_current < len(
+                saved_names
+            ):
+                held = saved_names[saved_current]
+                if held in self.datasets:
+                    self.current_iterator = self.datasets.index(held)
+                else:
+                    logger.warning(
+                        "worker %d: the checkpoint held corpus %r "
+                        "mid-document but it is not in this run's mix; "
+                        "releasing the hold",
+                        self.rank,
+                        held,
+                    )
+            self.quarantined_corpora = [
+                n for n in saved_quarantined if n in self.datasets
+            ]
+        else:
+            # rescale: scalar mix state was dropped by the base class —
+            # the token-share controller re-converges to the target mix
+            # from zero while every corpus's document walk reshards
+            # exactly (zero replays) through its own sub-state below
+            self.tokens_seen = [0] * len(self.datasets)
+            self.current_iterator = -1
+            self.quarantined_corpora = []
+            logger.info(
+                "worker %d: elastic rescale (%d -> %d loader ranks) "
+                "resets per-corpus tokens_seen; the mix re-converges to "
+                "its target shares (document walks reshard exactly)",
+                self.rank,
+                self.load_worldsize,
+                self.worldsize,
+            )
+        self._rearm_snapshot = {n: -1 for n in self.quarantined_corpora}
+
+        for li, si in pair.items():
+            subdata = self.data[li]
             subdata.load_worldsize = self.load_worldsize
             subdata.load_state_dict(
-                [
-                    sd[self.statename("sample_iterator_states")][i]
-                    for sd in sharded_dicts
-                ],
+                [sd[states_key][si] for sd in sharded_dicts],
                 True,
             )
         return sharded_dicts

@@ -126,6 +126,7 @@ class Observer:
         step_time_s: Optional[float] = None,
         memory_reserved_bytes: Optional[int] = None,
         memory_allocated_bytes: Optional[int] = None,
+        data_mix: Optional[Dict[str, float]] = None,
         extra: Optional[Dict[str, float]] = None,
     ) -> Dict:
         """Close the phase window, derive goodput/MFU, emit to sinks.
@@ -205,6 +206,9 @@ class Observer:
             # v6: supervisor restart accounting (restart ledger)
             "restarts": self.restarts,
             "restart_downtime_s": self.restart_downtime_s,
+            # v7: per-corpus data-mix accounting ("<corpus>.<stat>"
+            # flat map); None when the run has no live mixing layer
+            "data_mix": dict(data_mix) if data_mix else None,
             "kernel_tuning": self.kernel_tuning,
             "quantized_matmuls": self.quantized_matmuls,
             "quantized_reduce": self.quantized_reduce,

@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -65,6 +65,18 @@ SCHEMA_FIELDS = {
     "goodput_overall": ("float", False),
     "skipped_steps": ("int", True),
     "skipped_steps_window": ("int", True),
+    # v7: multi-corpus data-mix accounting (docs/dataloader.md
+    # "Multi-corpus mixing"). Flat map keyed "<corpus>.<stat>" with
+    # stats tokens_seen / target_share / realized_share / quarantined
+    # (0|1) per corpus, filled at report cadence from the live loader's
+    # SamplingDataset layer — realized-vs-target share drift and a
+    # degraded (quarantined) mix are first-class record facts. Absent
+    # (null) on dummy-data runs and in worker_mode="process" (the
+    # parent's pipeline copies don't advance). The corpus lifecycle
+    # counters (data.corpus_quarantined / data.corpus_rearmed) and
+    # data.mix.<corpus>.tokens_seen gauges additionally ride in
+    # ``extra``.
+    "data_mix": ("map", False),
     # v6: self-healing supervisor accounting (docs/resilience.md
     # "Self-healing supervisor"). The relaunched run reads the
     # supervisor's restart ledger (FMS_RESTART_LEDGER) at observer
@@ -120,6 +132,9 @@ SCHEMA_DIGESTS = {
     # v6: + restarts / restart_downtime_s (self-healing supervisor:
     # restart-ledger accounting, downtime charged against goodput)
     6: "beafaf1c7f6338ad6693fe16ce1b2c4403c5447e3135e12b3776d5494864b8ce",
+    # v7: + data_mix (per-corpus tokens_seen / target vs realized share /
+    # quarantined flag from the weighted multi-corpus mixing layer)
+    7: "fed0cc09460e2c7da58cf4519e40e8d4e0ff6c25874b65fbd9d0e7f44ff83af9",
 }
 
 

@@ -41,6 +41,12 @@ preempted           6     reserved for schedulers that need preemption
 injected_kill       7     fault-injection hard-kills (slice_kill,
                           ckpt_precommit_kill) when the spec carries no
                           explicit ``code=``
+corpus_loss         8     CorpusLossError through the entry wrapper: the
+                          weighted data mix lost a corpus and fewer than
+                          ``min_live_corpora`` corpora remain live (losing
+                          the LAST corpus always breaches the floor) — the
+                          data is gone, not the worker, so the supervisor
+                          relaunches expecting the corpus restored
 ==================  ====  ===================================================
 
 ``classify_world`` merges one incarnation's per-host exit codes into the
@@ -76,6 +82,7 @@ EXIT_CODES: Dict[str, int] = {
     "loader_death": 5,
     "preempted": 6,
     "injected_kill": 7,
+    "corpus_loss": 8,
 }
 
 # most-causal-first: when one incarnation's hosts exit with different
@@ -86,6 +93,7 @@ EXIT_CODES: Dict[str, int] = {
 # must not pick the restart policy.
 CLASSIFY_PRIORITY = (
     "loader_death",
+    "corpus_loss",
     "anomaly_abort",
     "slice_loss",
     "watchdog_stall",
@@ -166,6 +174,14 @@ def classify_exception(e: BaseException) -> Optional[str]:
         from fms_fsdp_tpu.data.loader import LoaderWorkerError
 
         checks.append((LoaderWorkerError, "loader_death"))
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from fms_fsdp_tpu.data.streaming import CorpusLossError
+
+        # BEFORE the isinstance sweep order matters only across types
+        # that nest; CorpusLossError and LoaderWorkerError are disjoint
+        checks.append((CorpusLossError, "corpus_loss"))
     except Exception:  # noqa: BLE001
         pass
     for typ, name in checks:

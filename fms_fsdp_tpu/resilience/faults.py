@@ -52,6 +52,15 @@ dcn_reduce_stall
                 cross-slice reduce whose hang the slice/step watchdogs
                 must convert into an actionable report instead of a
                 burned reservation
+corpus_kill     SamplingDataset document boundaries and re-probe
+                attempts (data/streaming.py): a match simulates every
+                owned shard of the named corpus dying at once — the
+                corpus quarantines and the mix degrades (weights
+                renormalized over survivors) or, below the
+                ``min_live_corpora`` floor, exits classified as
+                ``corpus_loss``. Filtered by ``corpus`` (substring, so
+                one clause can kill a corpus family); ``times=N`` lets
+                the survivor-epoch re-probe heal it after N matches
 ==============  =======================================================
 
 Spec strings configure the registry, via the ``FMS_FAULTS`` environment
@@ -61,8 +70,8 @@ variable or ``TrainConfig.faults``::
     e.g.  "shard_read:path=quartershard:times=2;nan_loss:step=5:count=3"
 
 Filter params are matched against the call-site context before firing:
-``path`` / ``op`` / ``tier`` (substring), ``worker`` / ``batch`` /
-``step`` / ``slice`` (equality). A configured filter the call site does not supply in its
+``path`` / ``op`` / ``tier`` / ``corpus`` (substring), ``worker`` /
+``batch`` / ``step`` / ``slice`` (equality). A configured filter the call site does not supply in its
 context is a non-match (the fault does not fire) — a typo'd filter must
 never degrade into firing everywhere.
 ``times=N`` caps the number of fires (per process; counters are
@@ -84,7 +93,9 @@ _FIRED: Dict[str, int] = {}
 ENV_VAR = "FMS_FAULTS"
 
 # params that filter whether a call-site context matches (vs payload)
-_FILTER_KEYS = ("path", "op", "worker", "batch", "step", "tier", "slice")
+_FILTER_KEYS = (
+    "path", "op", "worker", "batch", "step", "tier", "slice", "corpus",
+)
 
 
 def _parse_value(v: str):

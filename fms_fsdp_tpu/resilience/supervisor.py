@@ -27,6 +27,11 @@ anomaly_abort   relaunch from the last committed checkpoint after a cooldown
                 poisoned data region would just re-abort)
 watchdog_stall  relaunch with backoff
 loader_death    relaunch with backoff
+corpus_loss     relaunch with backoff: the data mix dropped below its
+                ``min_live_corpora`` floor (data/streaming.py) — the
+                relaunch expects the corpus storage restored; a corpus
+                still dead re-exits corpus_loss and the crash-loop guard
+                ends it with the quarantine list in the post-mortem
 injected_kill   relaunch with backoff (fault-injection hard kills)
 error           bounded generic retry with backoff (unknown exit codes)
 ==============  =============================================================
@@ -110,6 +115,10 @@ def default_policies(
         "anomaly_abort": RestartPolicy(cooldown_s=anomaly_cooldown_s),
         "watchdog_stall": RestartPolicy(),
         "loader_death": RestartPolicy(),
+        # the data itself is gone (mix below min_live_corpora), not the
+        # worker: relaunch with backoff expecting the corpus restored —
+        # a still-dead corpus re-exits and the crash-loop guard ends it
+        "corpus_loss": RestartPolicy(),
         "injected_kill": RestartPolicy(),
         "error": RestartPolicy(),
     }

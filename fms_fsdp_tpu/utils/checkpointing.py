@@ -204,6 +204,7 @@ class Checkpointer:
         # gate — the entry points always set one via set_fingerprint.
         self.fingerprint: dict = None
         self.allow_batch_change = False
+        self.allow_corpus_change = False
 
         import orbax.checkpoint as ocp
 
@@ -216,7 +217,12 @@ class Checkpointer:
             for k, v in kwargs.items():
                 print(k, "=", v)
 
-    def set_fingerprint(self, fingerprint, allow_batch_change: bool = False):
+    def set_fingerprint(
+        self,
+        fingerprint,
+        allow_batch_change: bool = False,
+        allow_corpus_change: bool = False,
+    ):
         """Arm the elastic-resume contract: ``fingerprint`` (a
         ``ckpt/elastic.py`` topology dict for the LIVE world) is stamped
         into every save's metadata.json and compared against the
@@ -224,6 +230,7 @@ class Checkpointer:
         legality before any collective restore."""
         self.fingerprint = dict(fingerprint) if fingerprint else None
         self.allow_batch_change = bool(allow_batch_change)
+        self.allow_corpus_change = bool(allow_corpus_change)
 
     def resume_topology(self, candidates=None):
         """Topology fingerprint stamped into the checkpoint a resume
@@ -248,7 +255,11 @@ class Checkpointer:
         silently shifted document stream. No-op (bit-identical to the
         pre-elastic behavior) when topologies match, when either side
         carries no fingerprint, or on single-file checkpoints."""
-        from fms_fsdp_tpu.ckpt.elastic import check_rescale, describe_change
+        from fms_fsdp_tpu.ckpt.elastic import (
+            check_rescale,
+            describe_change,
+            describe_mixing_change,
+        )
 
         if self.fingerprint is None:
             return
@@ -273,6 +284,7 @@ class Checkpointer:
             self.fingerprint,
             ckp_dir=load_path,
             allow_batch_change=self.allow_batch_change,
+            allow_corpus_change=self.allow_corpus_change,
         )
         # collective verdict: the loader-file count is a local listdir
         # that eventually-consistent storage could split across hosts,
@@ -291,6 +303,12 @@ class Checkpointer:
                 f"model/optimizer reshard onto the live mesh and loader "
                 f"state reshards across the new ranks."
             )
+            # legal data-mix changes (weight change, corpus reorder) are
+            # worth a line of their own: the realized mix shifts even
+            # though nothing is lost
+            mix_note = describe_mixing_change(topo, self.fingerprint)
+            if mix_note:
+                self.report(f"Elastic resume mixing note: {mix_note}")
 
     # -- path resolution ----------------------------------------------------
 

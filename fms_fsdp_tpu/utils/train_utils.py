@@ -271,6 +271,39 @@ class PreemptionGuard:
         return agreed
 
 
+def _mix_record(observer, dataloader):
+    """Per-corpus data-mix accounting for the report record (obs schema
+    v7 ``data_mix``): drains the SamplingDataset's buffered lifecycle
+    events into the registry (data.corpus_quarantined / corpus_rearmed
+    counters) and reads realized-vs-target token shares from the live
+    loader. None when the run carries no mixing layer (dummy data,
+    process-mode workers)."""
+    from fms_fsdp_tpu.data.loader import loader_mix_stats
+    from fms_fsdp_tpu.data.streaming import drain_mix_events
+
+    for name, n in drain_mix_events().items():
+        if n:
+            observer.registry.counter(f"data.{name}").add(n)
+    mix = loader_mix_stats(dataloader) if dataloader is not None else None
+    if mix is None:
+        return None
+    total = sum(mix["tokens"].values())
+    record = {}
+    for corpus, tokens in mix["tokens"].items():
+        observer.registry.gauge(f"data.mix.{corpus}.tokens_seen").set(tokens)
+        record[f"{corpus}.tokens_seen"] = tokens
+        record[f"{corpus}.target_share"] = round(
+            mix["weights"].get(corpus, 0.0), 6
+        )
+        record[f"{corpus}.realized_share"] = (
+            round(tokens / total, 6) if total else 0.0
+        )
+        record[f"{corpus}.quarantined"] = (
+            1 if corpus in mix["quarantined"] else 0
+        )
+    return record
+
+
 def train(
     cfg,
     state,
@@ -535,6 +568,7 @@ def _train_loop(
         record_extra = dict(extra_metrics)
         if poisoned:
             record_extra["window_poisoned"] = 1
+        data_mix = _mix_record(observer, dataloader)
         observer.report(
             step,
             len(fetched),
@@ -551,6 +585,7 @@ def _train_loop(
             skipped_steps_window=window_skips,
             memory_reserved_bytes=reserved_mem,
             memory_allocated_bytes=allocated_mem,
+            data_mix=data_mix,
             extra=record_extra,
         )
         start = time.time()

@@ -101,6 +101,7 @@ def main(**kwargs):
     checkpointer.set_fingerprint(
         current_fingerprint(cfg),
         allow_batch_change=cfg.allow_batch_change,
+        allow_corpus_change=getattr(cfg, "allow_corpus_change", False),
     )
     local_batch = cfg.batch_size * (data_extent // world_size)
     if not cfg.use_dummy_dataset:

@@ -114,16 +114,54 @@ def build_parquet_dataset(root, n_files=4, docs_per_file=400, words_per_doc=700)
             f.write(f"{name},{d},{d * words_per_doc}\n")
 
 
+def build_mixed_dataset(root, n_files=2, docs_per_file=1000, doc_len=1000):
+    """Three weighted arrow corpora for the mixed-mode row (same token
+    format as build_dataset, split across corpus directories)."""
+    schema = pa.schema([pa.field("tokens", pa.uint32())])
+    rng = np.random.default_rng(3)
+    meta = []
+    for name in ("dataset_1", "dataset_2", "dataset_3"):
+        os.makedirs(os.path.join(root, name), exist_ok=True)
+        for f in range(n_files):
+            path = os.path.join(root, name, f"shard_{f}.arrow")
+            with pa.ipc.new_file(path, schema) as w:
+                for _ in range(docs_per_file):
+                    doc = rng.integers(0, 32000, size=doc_len, dtype=np.uint32)
+                    w.write(pa.record_batch([pa.array(doc)], schema))
+            meta.append(
+                (f"/{name}/shard_{f}.arrow", docs_per_file,
+                 docs_per_file * doc_len)
+            )
+    os.makedirs(os.path.join(root, "meta"), exist_ok=True)
+    with open(os.path.join(root, "meta", "combined_counts.csv"), "w") as f:
+        f.write("dataset/filename,documents,tokens\n")
+        for name, d, t in meta:
+            f.write(f"{name},{d},{t}\n")
+    return sum(m[2] for m in meta)
+
+
 def run_mode(mode, num_workers, n_batches, worker_mode="thread"):
     from fms_fsdp_tpu.config import TrainConfig
     from fms_fsdp_tpu.data import get_data_loader
 
+    mix_extras = {}
     if mode == "arrow":
         root = "/tmp/bench_loader_data"
         if not os.path.exists(os.path.join(root, "meta")):
             total = build_dataset(root)
             print(f"# built {total/1e6:.0f}M tokens", file=sys.stderr)
         extra = dict(file_type="arrow", vocab_size=32000)
+    elif mode == "mixed":
+        root = "/tmp/bench_loader_mixed"
+        if not os.path.exists(os.path.join(root, "meta")):
+            total = build_mixed_dataset(root)
+            print(f"# built {total/1e6:.0f}M mixed tokens", file=sys.stderr)
+        extra = dict(
+            file_type="arrow",
+            vocab_size=32000,
+            datasets="dataset_1,dataset_2,dataset_3",
+            weights="2,1,1",
+        )
     else:
         root = "/tmp/bench_loader_parquet"
         tok_dir = "/tmp/bench_loader_tok"
@@ -142,8 +180,8 @@ def run_mode(mode, num_workers, n_batches, worker_mode="thread"):
 
     cfg = TrainConfig(
         data_path=root,
-        datasets="dataset_1",
-        weights="1",
+        datasets=extra.pop("datasets", "dataset_1"),
+        weights=extra.pop("weights", "1"),
         seq_length=4096,
         batch_size=4,
         bos_token=None,
@@ -164,9 +202,25 @@ def run_mode(mode, num_workers, n_batches, worker_mode="thread"):
     for _ in range(n_batches):
         next(it)
     dt = time.perf_counter() - t0
+    tok_s = n_batches * cfg.batch_size * cfg.seq_length / dt
+    if mode == "mixed":
+        # per-corpus goodput: realized token shares from the live
+        # mixing layer x pulled throughput
+        from fms_fsdp_tpu.data import loader_mix_stats
+
+        mix = loader_mix_stats(loader) or {"tokens": {}, "quarantined": []}
+        total = sum(mix["tokens"].values()) or 1
+        mix_extras = {
+            "per_corpus_tokens_per_sec": {
+                n: round(tok_s * t / total) for n, t in mix["tokens"].items()
+            },
+            "realized_shares": {
+                n: round(t / total, 3) for n, t in mix["tokens"].items()
+            },
+        }
     if hasattr(loader, "shutdown"):
         loader.shutdown()
-    return n_batches * cfg.batch_size * cfg.seq_length / dt
+    return tok_s, mix_extras
 
 
 def main():
@@ -177,6 +231,11 @@ def main():
     nw = int(os.environ.get("BENCH_WORKERS", "8"))
     plans = [
         ("arrow", 1, 200, "thread"),
+        # weighted 3-corpus mixing over the same arrow path: the mix
+        # overhead vs the flat corpus (SamplingDataset bookkeeping +
+        # per-corpus reader churn) and per-corpus goodput become
+        # regression-measurable
+        ("mixed", 1, 200, "thread"),
         ("parquet", 1, 40, "thread"),
         # worker scaling, both parallelism models: threads lean on the
         # tokenizer's GIL-releasing rust encode; processes are the
@@ -186,18 +245,25 @@ def main():
         ("parquet", nw, 40, "thread"),
         ("parquet", nw, 40, "process"),
     ]
+    flat_arrow_tok_s = None
     for mode, workers, n_batches, wmode in plans:
-        tok_s = run_mode(mode, workers, n_batches, wmode)
-        rows.append(
-            {
-                "pipeline": mode,
-                "num_workers": workers,
-                "worker_mode": wmode,
-                "tokens_per_sec": round(tok_s),
-                "vs_8chip_194m_demand": round(tok_s / demand_194m, 2),
-                "vs_8chip_7b_demand": round(tok_s / demand_7b, 2),
-            }
-        )
+        tok_s, mix_extras = run_mode(mode, workers, n_batches, wmode)
+        row = {
+            "pipeline": mode,
+            "num_workers": workers,
+            "worker_mode": wmode,
+            "tokens_per_sec": round(tok_s),
+            "vs_8chip_194m_demand": round(tok_s / demand_194m, 2),
+            "vs_8chip_7b_demand": round(tok_s / demand_7b, 2),
+        }
+        if mode == "arrow":
+            flat_arrow_tok_s = tok_s
+        if mode == "mixed":
+            row.update(mix_extras)
+            if flat_arrow_tok_s:
+                # < 1.0 = the mix costs throughput vs the flat corpus
+                row["mix_vs_flat_corpus"] = round(tok_s / flat_arrow_tok_s, 2)
+        rows.append(row)
         print(json.dumps(rows[-1]), file=sys.stderr)
 
     result = {

@@ -26,11 +26,28 @@ the loop. It then runs the identical config fault-free and asserts:
   every incarnation's recompile as compute, so it cannot fairly compare
   a restarted run against a straight one at CPU-test scale.)
 
+The soak drives a WEIGHTED 3-CORPUS mix (datasets 2:1:1,
+``min_live_corpora=2`` — the data-layer twin of the slice fault domain):
+per-corpus markers live in disjoint ranges so the replay and share
+checks hold corpus by corpus, and the realized per-corpus document
+shares of the effective stream must sit within tolerance of the
+configured weights.
+
 Fault pool (kill-class — the run dies and the supervisor relaunches it
 through elastic resume, so every redone step is bit-identical):
 
 - ``slice_kill``          whole-slice loss (always scheduled — the
                           acceptance criterion's fault domain kill)
+- ``corpus_kill``         whole-corpus loss (always scheduled): every
+                          corpus matching the spec dies at its next
+                          document boundary — the first loss degrades
+                          the mix (quarantine + weights renormalized
+                          over survivors, asserted from the logs), the
+                          second breaches ``min_live_corpora`` and exits
+                          via the classified ``corpus_loss`` registry
+                          code before anything commits; the relaunch
+                          finds the corpus healed (the fault arms per
+                          incarnation) so end-state bit-identity holds
 - ``ckpt_precommit_kill`` death between snapshot and commit marker
 - ``dcn_reduce_stall``    a parked rank; the step watchdog converts the
                           hang into a classified exit
@@ -81,27 +98,44 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _marked_corpus(root, n_shards=4, docs_per_shard=200, doc_len=80):
-    """Arrow corpus where doc d opens with unique marker 1024+d (same
-    construction as tests/test_elastic.py): a marker appearing twice in
-    the effective consumed stream is a replayed document."""
+MARKER_BASE = 1024
+CORPORA = ["dataset_1", "dataset_2", "dataset_3"]
+MIX_WEIGHTS = "2,1,1"
+DOCS_PER_CORPUS = 300
+MIN_LIVE_CORPORA = 2
+
+
+def _marked_corpus(root, docs_per_corpus=DOCS_PER_CORPUS, doc_len=80):
+    """Weighted-mix arrow corpora (same construction as
+    tests/test_elastic.py::_marked_mixed_corpus): corpus c's documents
+    open with unique markers in the disjoint range
+    [MARKER_BASE + c*docs_per_corpus, MARKER_BASE + (c+1)*docs_per_corpus),
+    so a marker appearing twice in the effective consumed stream is a
+    replayed document — checkable corpus by corpus."""
     import pyarrow as pa
 
     root = str(root)
-    os.makedirs(os.path.join(root, "dataset_1"), exist_ok=True)
+    assert MARKER_BASE + len(CORPORA) * docs_per_corpus <= 2048
     schema = pa.schema([pa.field("tokens", pa.uint32())])
-    rows, d = [], 0
-    for s in range(n_shards):
-        path = os.path.join(root, "dataset_1", f"shard_{s}.arrow")
-        with pa.ipc.new_file(path, schema) as w:
-            for _ in range(docs_per_shard):
-                body = [(d * 31 + j) % 997 + 1 for j in range(doc_len - 1)]
-                w.write(pa.record_batch([[1024 + d] + body], schema))
-                d += 1
-        rows.append(
-            (f"/dataset_1/shard_{s}.arrow", docs_per_shard,
-             docs_per_shard * doc_len)
-        )
+    rows = []
+    for c, name in enumerate(CORPORA):
+        os.makedirs(os.path.join(root, name), exist_ok=True)
+        base = MARKER_BASE + c * docs_per_corpus
+        d = 0
+        for s in range(2):
+            path = os.path.join(root, name, f"shard_{s}.arrow")
+            with pa.ipc.new_file(path, schema) as w:
+                for _ in range(docs_per_corpus // 2):
+                    body = [
+                        ((base + d) * 31 + j) % 997 + 1
+                        for j in range(doc_len - 1)
+                    ]
+                    w.write(pa.record_batch([[base + d] + body], schema))
+                    d += 1
+            rows.append(
+                (f"/{name}/shard_{s}.arrow", docs_per_corpus // 2,
+                 (docs_per_corpus // 2) * doc_len)
+            )
     os.makedirs(os.path.join(root, "meta"), exist_ok=True)
     with open(os.path.join(root, "meta", "combined_counts.csv"), "w") as f:
         f.write("dataset/filename,documents,tokens\n")
@@ -110,19 +144,27 @@ def _marked_corpus(root, n_shards=4, docs_per_shard=200, doc_len=80):
     return root
 
 
+def _corpus_of(marker):
+    return (marker - MARKER_BASE) // DOCS_PER_CORPUS
+
+
 def sample_schedule(seed: int, budget: int, ckpt_interval: int, n_sites: int):
     """The seeded fault schedule: one fault spec per incarnation,
     ``slice_kill`` always first (the world is still 2-slice and the
-    whole-domain loss is the acceptance criterion), the rest drawn from
-    the registry pool at ascending steps so each fault fires after the
+    whole-domain loss is the acceptance criterion), ``corpus_kill``
+    always second (the data-layer fault domain), the rest drawn from the
+    registry pool at ascending steps so each fault fires after the
     previous incarnation's resume point."""
     rng = random.Random(seed)
     pool = ["ckpt_precommit_kill", "dcn_reduce_stall", "loader_worker"]
     rng.shuffle(pool)
-    sites = ["slice_kill"] + pool[: max(0, n_sites - 1)]
+    sites = ["slice_kill", "corpus_kill"] + pool[: max(0, n_sites - 2)]
     # ascending fire positions, >= one commit apart so every resume
     # point (a committed multiple of ckpt_interval) precedes the next
-    # fault; jitter keeps the schedule seed-dependent
+    # fault; jitter keeps the schedule seed-dependent. (corpus_kill
+    # ignores its position: it fires at its incarnation's first
+    # document boundaries, cascades to the min_live_corpora breach and
+    # exits corpus_loss before anything commits.)
     positions, pos = [], ckpt_interval + 2
     for _ in sites:
         positions.append(min(pos + rng.randrange(0, 2), budget - 2))
@@ -131,6 +173,10 @@ def sample_schedule(seed: int, budget: int, ckpt_interval: int, n_sites: int):
     for site, p in zip(sites, positions):
         if site == "slice_kill":
             spec = f"slice_kill:slice=1:step={p}"
+        elif site == "corpus_kill":
+            # substring filter: every corpus matches, so the cascade
+            # (degrade -> renormalize -> floor breach) is deterministic
+            spec = "corpus_kill:corpus=dataset_"
         elif site == "ckpt_precommit_kill":
             # must land on the commit cadence to fire
             at = min(((p + ckpt_interval - 1) // ckpt_interval)
@@ -163,6 +209,12 @@ def child_specs(ckpt, data, walk, obs_dir, hb_dir, phase, num_steps,
         # vs the fault-free run a provable property
         "feed_prefetch=0",
         f"obs_dir={obs_dir}",
+        # the weighted 3-corpus mix (module docstring): disjoint marker
+        # ranges per corpus; min_live_corpora=2 makes the second corpus
+        # loss of a corpus_kill cascade a classified corpus_loss exit
+        f"datasets={','.join(CORPORA)}",
+        f"weights={MIX_WEIGHTS}",
+        f"min_live_corpora={MIN_LIVE_CORPORA}",
     ]
     specs = []
     for pid in range(2):
@@ -236,7 +288,7 @@ def _fired_faults(entries):
     child exited with a registry code (the os._exit / classified-exit
     paths), which environment failures (SIGABRT, generic tracebacks)
     never produce."""
-    registry = {2, 3, 4, 5, 7}
+    registry = {2, 3, 4, 5, 7, 8}
     return sum(
         1
         for e in entries
@@ -321,6 +373,26 @@ def run_soak(args, workdir):
                 f"only {fired} fault(s) fired of {len(plan)} scheduled; "
                 f"ledger: {res.ledger}"
             )
+            # corpus_kill contract: the first corpus loss DEGRADED the
+            # mix (quarantine + weights renormalized over survivors —
+            # the one actionable line, asserted from the child logs)
+            # before the second breached min_live_corpora into the
+            # classified corpus_loss exit the supervisor relaunched
+            logs_text = ""
+            for fn in sorted(os.listdir(logs)):
+                if fn.startswith("attempt"):
+                    with open(
+                        os.path.join(logs, fn), errors="replace"
+                    ) as fh:
+                        logs_text += fh.read()
+            assert "renormalized over survivors" in logs_text, (
+                "corpus_kill never degraded the mix: no renormalize "
+                "line in any attempt log"
+            )
+            assert any(
+                e.get("classification") == "corpus_loss"
+                for e in res.ledger["entries"]
+            ), f"no corpus_loss classification in {res.ledger}"
 
         # committed windows per incarnation: attempt k resumed at the
         # START_STEP its log printed; its committed prefix ends where
@@ -427,6 +499,21 @@ def run_soak(args, workdir):
         f"despite {f['restart_downtime_s']}s downtime and "
         f"{f['supervisor_restarts']} restart(s)"
     )
+    # per-corpus document shares of the effective committed stream sit
+    # within tolerance of the configured weights (equal doc lengths, so
+    # document share ~= token share); generous bound — the run is only
+    # budget_steps long and the walk includes reservoir lookahead
+    mix_w = [float(w) for w in MIX_WEIGHTS.split(",")]
+    targets = [w / sum(mix_w) for w in mix_w]
+    counts = [0] * len(CORPORA)
+    for m in f["markers"]:
+        counts[_corpus_of(m)] += 1
+    shares = [n / max(1, len(f["markers"])) for n in counts]
+    for name, share, target in zip(CORPORA, shares, targets):
+        assert share > 0 and abs(share - target) < 0.2, (
+            f"corpus {name} realized share {share:.3f} vs target "
+            f"{target:.3f} (doc counts {counts})"
+        )
     summary = {
         "seed": args.seed,
         "budget_steps": args.budget_steps,
@@ -438,6 +525,12 @@ def run_soak(args, workdir):
         "straight_run_steps_per_s": c["straight_steps_per_s"],
         "clean_env_restarts": c["supervisor_restarts"],
         "effective_documents": len(f["markers"]),
+        "corpus_shares": {
+            name: round(share, 3) for name, share in zip(CORPORA, shares)
+        },
+        "corpus_share_targets": {
+            name: round(t, 3) for name, t in zip(CORPORA, targets)
+        },
         "ok": True,
     }
     print(json.dumps(summary, indent=1))
@@ -450,8 +543,8 @@ def main(argv=None):
     ap.add_argument("--budget-steps", type=int, default=24)
     ap.add_argument("--ckpt-interval", type=int, default=4)
     ap.add_argument("--sites", type=int, default=3,
-                    help="distinct fault sites to schedule (>=1; "
-                    "slice_kill always included)")
+                    help="distinct fault sites to schedule (>=2; "
+                    "slice_kill and corpus_kill always included)")
     ap.add_argument("--backoff-s", type=float, default=0.2)
     ap.add_argument("--workdir", default=None,
                     help="working directory (kept); default: a temp dir, "

@@ -120,8 +120,9 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
     )
 
     cfg = TrainConfig()
-    update_config(
-        cfg,
+    # key=value overrides from argv take precedence over the defaults
+    # below (e.g. the mixed-corpus e2e passes datasets=.../weights=...)
+    base_kwargs = dict(
         use_dummy_dataset=False,
         data_path=data_path,
         datasets="dataset_1",
@@ -144,8 +145,9 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
         ckpt_save_path=ckpt_dir,
         ckpt_load_path=ckpt_dir,
         faults=faults,
-        **dict(kv.split("=", 1) for kv in overrides),
     )
+    base_kwargs.update(dict(kv.split("=", 1) for kv in overrides if kv))
+    update_config(cfg, **base_kwargs)
     if cfg.faults:
         from fms_fsdp_tpu.resilience.faults import configure_faults
 
@@ -187,7 +189,9 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
             cfg, resume_topology, data_extent, rank
         )
     checkpointer.set_fingerprint(
-        current_fingerprint(cfg), allow_batch_change=cfg.allow_batch_change
+        current_fingerprint(cfg),
+        allow_batch_change=cfg.allow_batch_change,
+        allow_corpus_change=getattr(cfg, "allow_corpus_change", False),
     )
 
     local_batch = cfg.batch_size * (data_extent // world_size)
@@ -213,6 +217,23 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
     print("START_STEP", start_step, flush=True)
     print("TOKENS_SEEN", tokens_seen, flush=True)
     print("STATE_HASH", _state_hash(state, mesh), flush=True)
+    # per-corpus mix state after restore (multi-corpus e2e): present
+    # only once the restored pipeline is set up (i.e. when resuming)
+    from fms_fsdp_tpu.data.loader import loader_mix_stats
+
+    mix = loader_mix_stats(loader)
+    if mix is not None:
+        print(
+            "MIX_TOKENS",
+            " ".join(
+                f"{n}={mix['tokens'][n]}" for n in sorted(mix["tokens"])
+            ),
+            flush=True,
+        )
+        print(
+            "MIX_QUARANTINED", ",".join(mix["quarantined"]) or "-",
+            flush=True,
+        )
     if "quant" in state:
         # delayed-scaling rows with a live (nonzero) newest amax — a
         # resume that silently re-initialized the history would print 0

@@ -849,3 +849,246 @@ def test_worker_mode_process_reiteration_multiworker(datadir):
     it3 = iter(loader)
     next(it3)
     loader.shutdown()
+
+
+# ---- multi-corpus mixing hardening (docs/dataloader.md) --------------------
+
+
+def test_sampling_autodiscovery_is_sorted(datadir, monkeypatch):
+    """os.listdir order is filesystem-dependent: auto-discovered corpus
+    order must be sorted or ranks/hosts could disagree and diverge the
+    mix (and misassign per-index state)."""
+    bl, bs, bsc, bss = make_factories(datadir)
+    real_listdir = os.listdir
+
+    def reversed_listdir(path):
+        return sorted(real_listdir(path), reverse=True)
+
+    monkeypatch.setattr(os, "listdir", reversed_listdir)
+    d = SamplingDataset(datadir, bl(), -1, datasets=None)
+    assert d.datasets == sorted(d.datasets)
+    assert "dataset_1" in d.datasets and "dataset_2" in d.datasets
+
+
+def test_sampling_state_roundtrip_by_name(datadir):
+    """Resume pairs per-corpus state by NAME: a reordered --datasets
+    list restores every corpus's tokens_seen and stream position
+    unchanged (index pairing would swap them)."""
+    bl, bs, bsc, bss = make_factories(datadir)
+    d = SamplingDataset(
+        datadir, bl(), -1,
+        datasets=["dataset_1", "dataset_2"], weights=[2, 1],
+    )
+    it = iter(d)
+    for _ in range(30):
+        next(it)
+    state = d.state_dict()
+    tokens = dict(zip(d.datasets, d.tokens_seen))
+
+    d2 = SamplingDataset(
+        datadir, bl(), -1,
+        datasets=["dataset_2", "dataset_1"], weights=[1, 2],
+    )
+    d2.load_state_dict([state], sharded_input=True)
+    assert dict(zip(d2.datasets, d2.tokens_seen)) == tokens
+    # the held (mid-document) corpus followed its name too
+    if state["SamplingDataset.current_iterator"] != -1:
+        held = state["SamplingDataset.corpus_names"][
+            state["SamplingDataset.current_iterator"]
+        ]
+        assert d2.datasets[d2.current_iterator] == held
+    # streams continue without error
+    it2 = iter(d2)
+    for _ in range(10):
+        next(it2)
+
+
+def test_sampling_corpus_set_change_gated(datadir):
+    """A changed corpus set is an actionable error (state cannot follow
+    added/removed corpora); allow_corpus_change accepts it with removed
+    corpora dropped and new corpora starting cold."""
+    bl, bs, bsc, bss = make_factories(datadir)
+    d = SamplingDataset(
+        datadir, bl(), -1, datasets=["dataset_1", "dataset_2"],
+    )
+    it = iter(d)
+    for _ in range(20):
+        next(it)
+    state = d.state_dict()
+    d1_tokens = d.tokens_seen[0]
+
+    d2 = SamplingDataset(datadir, bl(), -1, datasets=["dataset_1"])
+    with pytest.raises(RuntimeError, match="allow_corpus_change"):
+        d2.load_state_dict([state], sharded_input=True)
+
+    d3 = SamplingDataset(
+        datadir, bl(), -1, datasets=["dataset_1"],
+        allow_corpus_change=True,
+    )
+    d3.load_state_dict([state], sharded_input=True)
+    assert d3.tokens_seen == [d1_tokens]
+
+
+def test_sampling_legacy_state_pairs_by_index(datadir):
+    """Pre-name-keyed state (no corpus_names key) still loads by index
+    when the corpus count matches, and errors when it cannot."""
+    bl, bs, bsc, bss = make_factories(datadir)
+    d = SamplingDataset(
+        datadir, bl(), -1, datasets=["dataset_1", "dataset_2"],
+    )
+    it = iter(d)
+    for _ in range(10):
+        next(it)
+    state = d.state_dict()
+    state.pop("SamplingDataset.corpus_names")
+    state.pop("SamplingDataset.mix_weights")
+
+    d2 = SamplingDataset(
+        datadir, bl(), -1, datasets=["dataset_1", "dataset_2"],
+    )
+    d2.load_state_dict([state], sharded_input=True)
+    assert d2.tokens_seen == d.tokens_seen
+
+    d3 = SamplingDataset(datadir, bl(), -1, datasets=["dataset_1"])
+    with pytest.raises(RuntimeError, match="legacy"):
+        d3.load_state_dict([state], sharded_input=True)
+
+
+def test_sampling_corpus_quarantine_renormalizes(datadir):
+    """corpus_kill on one corpus: the mix degrades to the survivors
+    (weights renormalized — the stream keeps flowing from dataset_1
+    only) instead of dying, and the lifecycle counter fires."""
+    from fms_fsdp_tpu.data.streaming import drain_mix_events
+    from fms_fsdp_tpu.resilience.faults import configure_faults
+
+    bl, bs, bsc, bss = make_factories(datadir)
+    drain_mix_events()
+    configure_faults("corpus_kill:corpus=dataset_2")
+    try:
+        d = SamplingDataset(
+            datadir, bl(), -1,
+            datasets=["dataset_1", "dataset_2"], weights=[1, 1],
+        )
+        it = iter(d)
+        outs = [next(it) for _ in range(40)]
+        assert d.quarantined_corpora == ["dataset_2"]
+        assert d.tokens_seen[1] == 0  # nothing ever drawn from the dead corpus
+        assert sum(len(o) for o in outs) == d.tokens_seen[0]
+        events = drain_mix_events()
+        assert events["corpus_quarantined"] == 1
+    finally:
+        configure_faults("")
+
+
+def test_sampling_min_live_corpora_floor(datadir):
+    """Dropping below min_live_corpora raises the classified
+    CorpusLossError (and losing the last corpus always does)."""
+    from fms_fsdp_tpu.data.streaming import CorpusLossError
+    from fms_fsdp_tpu.resilience.faults import configure_faults
+
+    bl, bs, bsc, bss = make_factories(datadir)
+    configure_faults("corpus_kill:corpus=dataset_2")
+    try:
+        d = SamplingDataset(
+            datadir, bl(), -1,
+            datasets=["dataset_1", "dataset_2"], weights=[1, 1],
+            min_live_corpora=2,
+        )
+        with pytest.raises(CorpusLossError, match="min_live_corpora"):
+            for _ in range(10):
+                next(iter(d))
+    finally:
+        configure_faults("")
+
+
+def test_sampling_quarantine_rearms_after_heal(datadir):
+    """A healed corpus re-arms at a survivor epoch boundary: the kill
+    fires once (times=1), the survivor wraps its epoch, the re-probe
+    succeeds and the corpus rejoins the mix."""
+    from fms_fsdp_tpu.resilience.faults import configure_faults
+
+    bl, bs, bsc, bss = make_factories(datadir)
+    configure_faults("corpus_kill:corpus=dataset_2:times=1")
+    try:
+        d = SamplingDataset(
+            datadir, bl(), -1,
+            datasets=["dataset_1", "dataset_2"], weights=[1, 1],
+        )
+        it = iter(d)
+        # dataset_1 is one 100-doc shard at chunksize 1000 (one chunk
+        # per doc): ~120 pulls forces an epoch wrap on the survivor,
+        # which re-arms the healed corpus
+        for _ in range(120):
+            next(it)
+        assert d.quarantined_corpora == []
+        assert d.tokens_seen[1] > 0, "healed corpus never rejoined the mix"
+    finally:
+        configure_faults("")
+
+
+def test_sampling_quarantine_state_roundtrip(datadir):
+    """The quarantined set rides in the state_dict; a resume restores it
+    (and the restored iterator re-probes at start — here the corpus is
+    still dead, so it stays quarantined)."""
+    from fms_fsdp_tpu.resilience.faults import configure_faults
+
+    bl, bs, bsc, bss = make_factories(datadir)
+    configure_faults("corpus_kill:corpus=dataset_2")
+    try:
+        d = SamplingDataset(
+            datadir, bl(), -1, datasets=["dataset_1", "dataset_2"],
+        )
+        it = iter(d)
+        for _ in range(10):
+            next(it)
+        state = d.state_dict()
+        assert state["SamplingDataset.quarantined_corpora"] == ["dataset_2"]
+
+        d2 = SamplingDataset(
+            datadir, bl(), -1, datasets=["dataset_1", "dataset_2"],
+        )
+        d2.load_state_dict([state], sharded_input=True)
+        assert d2.quarantined_corpora == ["dataset_2"]
+        it2 = iter(d2)
+        for _ in range(10):
+            next(it2)
+        assert d2.quarantined_corpora == ["dataset_2"]
+        assert d2.tokens_seen[1] == d.tokens_seen[1]
+    finally:
+        configure_faults("")
+
+
+from fms_fsdp_tpu.data import StatefulDataset as _StatefulDataset
+
+
+class _NoDelimiterStub(_StatefulDataset):
+    """A subdataset whose chunks never end with the delimiter — the
+    undelimited-tail-document pathology that used to pin
+    current_iterator forever."""
+
+    def __init__(self, datapath):
+        super().__init__(datapath, 0, 1)
+
+    def __iter__(self):
+        while True:
+            yield np.array([7, 7, 7], dtype=np.int64)
+
+
+def test_sampling_starvation_guard_releases_hold(datadir):
+    """max_held_chunks releases a document hold whose chunk stream never
+    emits the delimiter, so the other corpora keep serving instead of
+    starving forever."""
+    d = SamplingDataset(
+        datadir,
+        _NoDelimiterStub(datadir),
+        -1,
+        datasets=["dataset_1", "dataset_2"],
+        weights=[1, 1],
+        max_held_chunks=5,
+    )
+    it = iter(d)
+    for _ in range(40):
+        next(it)
+    # without the guard the first selected corpus is held forever and
+    # the other's tokens_seen stays 0
+    assert d.tokens_seen[0] > 0 and d.tokens_seen[1] > 0, d.tokens_seen

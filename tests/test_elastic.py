@@ -56,6 +56,8 @@ def _fp(**over):
         "num_slices": 1,
         "slice_process_count": 2,
         "slice_device_count": 8,
+        "corpus_names": "dataset_1,dataset_2",
+        "mix_weights_digest": "aaaa1111bbbb2222",
     }
     fp.update(over)
     return fp
@@ -132,6 +134,88 @@ def test_check_rescale_batch_change_needs_flag():
     assert any("allow_batch_change" in p for p in problems)
     problems, changed = check_rescale(_fp(), new, allow_batch_change=True)
     assert problems == [] and changed is True
+
+
+def test_check_rescale_mixing_legality_matrix():
+    """The v3 data-mix legality matrix: corpus-SET changes are gated
+    (state pairs by name and cannot follow added/removed corpora),
+    reorders and weight changes are legal — the latter two produce the
+    describe_mixing_change note the gate prints."""
+    from fms_fsdp_tpu.ckpt.elastic import (
+        check_rescale,
+        describe_mixing_change,
+    )
+
+    # corpus removed: actionable problem naming both escape hatches
+    new = _fp(corpus_names="dataset_1")
+    problems, _ = check_rescale(_fp(), new)
+    assert any("corpus set changed" in p for p in problems)
+    assert any("--datasets=dataset_1,dataset_2" in p for p in problems)
+    assert any("allow_corpus_change" in p for p in problems)
+    # ...accepted with the escape hatch
+    problems, changed = check_rescale(_fp(), new, allow_corpus_change=True)
+    assert problems == [] and changed is True
+
+    # corpus added: gated the same way
+    problems, _ = check_rescale(
+        _fp(), _fp(corpus_names="dataset_1,dataset_2,dataset_3")
+    )
+    assert any("corpus set changed" in p for p in problems)
+
+    # pure reorder: legal, note names the name-keyed pairing
+    reordered = _fp(corpus_names="dataset_2,dataset_1")
+    problems, changed = check_rescale(_fp(), reordered)
+    assert problems == [] and changed is True
+    note = describe_mixing_change(_fp(), reordered)
+    assert note and "pairs by name" in note
+
+    # weight change: legal, note says the controller re-steers
+    reweighted = _fp(mix_weights_digest="cccc3333dddd4444")
+    problems, changed = check_rescale(_fp(), reweighted)
+    assert problems == [] and changed is True
+    note = describe_mixing_change(_fp(), reweighted)
+    assert note and "weights changed" in note
+
+    # unchanged mix: no note
+    assert describe_mixing_change(_fp(), _fp()) is None
+
+
+def test_check_rescale_pre_v3_fingerprint_skips_mixing_checks():
+    """Pre-v3 fingerprints carry no mix fields: the mixing checks treat
+    them as wildcard (the load gate's version note still prints)."""
+    from fms_fsdp_tpu.ckpt.elastic import check_rescale, describe_mixing_change
+
+    v2 = {
+        k: v
+        for k, v in _fp().items()
+        if k not in ("corpus_names", "mix_weights_digest")
+    }
+    problems, changed = check_rescale(
+        v2, _fp(corpus_names="brand,new,set")
+    )
+    assert problems == [] and changed is True
+    assert describe_mixing_change(v2, _fp()) is None
+
+
+def test_mixing_fingerprint_from_config():
+    """current_fingerprint derives the mix dims from cfg.datasets /
+    cfg.weights; dummy-data runs fingerprint as empty (wildcard)."""
+    from fms_fsdp_tpu.ckpt.elastic import mixing_fingerprint
+    from fms_fsdp_tpu.config import TrainConfig
+
+    cfg = TrainConfig(datasets="a,b,c", weights="2,1,1")
+    names, digest = mixing_fingerprint(cfg)
+    assert names == "a,b,c" and len(digest) == 16
+    # weight digest is scale-invariant (normalized) but order-sensitive
+    assert mixing_fingerprint(
+        TrainConfig(datasets="a,b,c", weights="4,2,2")
+    ) == (names, digest)
+    assert mixing_fingerprint(
+        TrainConfig(datasets="a,b,c", weights="1,2,1")
+    )[1] != digest
+    assert mixing_fingerprint(
+        TrainConfig(use_dummy_dataset=True)
+    ) == ("", "")
 
 
 def test_check_rescale_slice_loss_is_legal():
@@ -563,6 +647,46 @@ def _marked_corpus(root, n_shards=4, docs_per_shard=200, doc_len=40):
     return root
 
 
+def _marked_mixed_corpus(root, corpora=3, docs_per_corpus=300, doc_len=80):
+    """Three-corpus variant of ``_marked_corpus``: corpus c's documents
+    carry markers in the disjoint range [MARKER_BASE + c*docs_per_corpus,
+    MARKER_BASE + (c+1)*docs_per_corpus), so replay checks work
+    per-corpus. All markers stay below the child's vocab_size=2048."""
+    root = str(root)
+    assert MARKER_BASE + corpora * docs_per_corpus <= 2048
+    schema = pa.schema([pa.field("tokens", pa.uint32())])
+    rows = []
+    for c in range(corpora):
+        name = f"dataset_{c + 1}"
+        os.makedirs(os.path.join(root, name), exist_ok=True)
+        base = MARKER_BASE + c * docs_per_corpus
+        d = 0
+        for s in range(2):
+            path = os.path.join(root, name, f"shard_{s}.arrow")
+            with pa.ipc.new_file(path, schema) as w:
+                for _ in range(docs_per_corpus // 2):
+                    body = [
+                        ((base + d) * 31 + j) % 997 + 1
+                        for j in range(doc_len - 1)
+                    ]
+                    w.write(pa.record_batch([[base + d] + body], schema))
+                    d += 1
+            rows.append(
+                (f"/{name}/shard_{s}.arrow", docs_per_corpus // 2,
+                 (docs_per_corpus // 2) * doc_len)
+            )
+    os.makedirs(os.path.join(root, "meta"), exist_ok=True)
+    with open(os.path.join(root, "meta", "combined_counts.csv"), "w") as f:
+        f.write("dataset/filename,documents,tokens\n")
+        for name, docs, toks in rows:
+            f.write(f"{name},{docs},{toks}\n")
+    return root
+
+
+def _corpus_of(marker, docs_per_corpus=300):
+    return (marker - MARKER_BASE) // docs_per_corpus
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -820,7 +944,7 @@ def test_multislice_slice_loss_resume(tmp_path):
     assert _grab(outs[3], "SLICE_CTX") == "2 1", outs[3][-2000:]
     with open(os.path.join(obs_save, "metrics.jsonl")) as f:
         recs = [json.loads(line) for line in f]
-    assert recs and all(r["schema_version"] == 6 for r in recs), recs
+    assert recs and all(r["schema_version"] == 7 for r in recs), recs
     assert any(r["dcn_collective_s"] > 0 for r in recs), recs
     assert any(r["ici_collective_s"] > 0 for r in recs), recs
 
@@ -884,3 +1008,82 @@ def test_multislice_slice_loss_resume(tmp_path):
         f"replayed documents across the slice-loss resume: "
         f"{sorted(m for m in set(both) if both.count(m) > 1)[:10]}"
     )
+
+
+@pytest.mark.slow
+def test_elastic_mixed_corpus_shrink_resume(tmp_path):
+    """The weighted 3-corpus shrink-restart e2e (docs/dataloader.md
+    "Multi-corpus mixing"): train at world=2 over three corpora mixed
+    2:1:1, commit at step 4, then
+
+    - a same-topology restart is a fingerprint no-op whose restored mix
+      state carries nonzero per-corpus tokens_seen (MIX_TOKENS — pairing
+      is by corpus name);
+    - a world=1 shrink-restart restores the train state bit-identically
+      (topology-independent STATE_HASH), preserves the 16-row global
+      batch, and continues every corpus's document walk with zero
+      replayed markers — the v3 fingerprint (corpus_names +
+      mix_weights_digest) rides the same gate as every other topology
+      field.
+    """
+    data = _marked_mixed_corpus(tmp_path / "data")
+    ckpt = str(tmp_path / "ckpt")
+    walk = str(tmp_path / "walk")
+    os.makedirs(walk)
+    mix = ["", "datasets=dataset_1,dataset_2,dataset_3", "weights=2,1,1"]
+
+    rcs, outs = _launch_world(2, [ckpt, data, walk, "save", "4", "4", *mix])
+    assert rcs == [0, 0], outs[0][-3000:] + outs[1][-3000:]
+
+    # same-topology restart: fingerprint no-op, name-keyed mix state back
+    rcs, outs_same = _launch_world(
+        2, [ckpt, data, walk, "same", "4", "4", *mix]
+    )
+    assert rcs == [0, 0], outs_same[0][-3000:] + outs_same[1][-3000:]
+    assert _grab(outs_same[0], "START_STEP") == "4"
+    assert "Elastic resume" not in outs_same[0], outs_same[0][-3000:]
+    ref_hash = _grab(outs_same[0], "STATE_HASH")
+    assert _grab(outs_same[1], "STATE_HASH") == ref_hash
+    mix_tokens = dict(
+        kv.split("=") for kv in _grab(outs_same[0], "MIX_TOKENS").split()
+    )
+    assert set(mix_tokens) == {"dataset_1", "dataset_2", "dataset_3"}
+    assert sum(int(v) for v in mix_tokens.values()) > 0, mix_tokens
+    assert _grab(outs_same[0], "MIX_QUARANTINED") == "-"
+
+    # world=1 shrink: bit-identical restore, preserved global batch,
+    # per-corpus walk continuation
+    rcs, outs_r = _launch_world(
+        1, [ckpt, data, walk, "resume", "8", "4", *mix]
+    )
+    assert rcs == [0], outs_r[0][-4000:]
+    out = outs_r[0]
+    assert _grab(out, "START_STEP") == "4"
+    assert _grab(out, "STATE_HASH") == ref_hash, out[-3000:]
+    assert "preserving the global batch of 16 rows" in out, out[-3000:]
+    assert "Elastic resume: restart topology differs" in out, out[-3000:]
+    # the rescale resets the per-corpus token targets (scalar mix state
+    # drops, like every position scalar) — but the walks reshard exactly
+    assert set(
+        kv.split("=")[0]
+        for kv in _grab(out, "MIX_TOKENS").split()
+    ) == {"dataset_1", "dataset_2", "dataset_3"}
+
+    before = _walk_markers(walk, "save")
+    after = _walk_markers(walk, "resume")
+    assert before and after, (len(before), len(after))
+    for c in range(3):
+        b = [m for m in before if _corpus_of(m) == c]
+        a = [m for m in after if _corpus_of(m) == c]
+        assert b and a, (
+            f"corpus {c + 1} missing from a phase "
+            f"({len(b)} before, {len(a)} after)"
+        )
+        both = b + a
+        assert len(both) == len(set(both)), (
+            f"corpus {c + 1} replayed documents across the shrink: "
+            f"{sorted(m for m in set(both) if both.count(m) > 1)[:10]}"
+        )
+    # the 2:1:1 weighting is visible in the document stream
+    counts = [len([m for m in before if _corpus_of(m) == c]) for c in range(3)]
+    assert counts[0] > counts[1] and counts[0] > counts[2], counts

@@ -935,7 +935,7 @@ def test_supervisor_autorestart_slice_kill_e2e(tmp_path):
     with open(os.path.join(obs, "metrics.jsonl")) as f:
         recs = [json.loads(ln) for ln in f if ln.strip()]
     last = recs[-1]
-    assert last["schema_version"] == 6
+    assert last["schema_version"] == 7
     assert last["restarts"] >= 1
     assert last["restart_downtime_s"] > 0
 
