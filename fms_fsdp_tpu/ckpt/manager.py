@@ -69,6 +69,7 @@ class CheckpointTier:
         rank=None,
         report_fn=None,
         verify: bool = True,
+        full_checksums: bool = True,
     ):
         self.name = name
         self.root = root
@@ -80,6 +81,7 @@ class CheckpointTier:
             rank=rank,
             report_fn=report_fn,
             verify=verify,
+            full_checksums=full_checksums,
         )
 
     def due(self, step: int) -> bool:
@@ -331,7 +333,17 @@ class AsyncCheckpointManager:
         maybe_raise_fault(
             "ckpt_durable_write", exc_cls=OSError, step=step, tier=tier.name
         )
-        write_manifest(save_name)
+        from fms_fsdp_tpu.resilience.scrub import clear_integrity_sidecars
+
+        # a re-commit into a previously-quarantined step dir (fallback
+        # resume trained back past it) carries fresh content: stale
+        # verdicts must not outlive the bytes they judged
+        clear_integrity_sidecars(save_name)
+        # full-content (chunked) checksums are computed HERE, on the
+        # background writer where the storage write was just waited out
+        # — the blocking snapshot at the step boundary never pays the
+        # hashing (docs/checkpointing.md "State integrity")
+        write_manifest(save_name, full_checksums=tier.ckp.full_checksums)
         # kill window between snapshot and commit marker: the dir is
         # fully written but uncommitted — resume must skip it and fall
         # back
@@ -344,7 +356,17 @@ class AsyncCheckpointManager:
             f.flush()
             os.fsync(f.fileno())
         os.replace(meta_path + ".tmp", meta_path)
+        # re-clear AFTER the commit marker lands: on a RE-commit the
+        # old metadata.json is visible throughout the manifest hash
+        # above, so a scrubber sweep in that window verifies the STALE
+        # manifest against the fresh payload, fails, and quarantines —
+        # without this, the freshly committed dir would be skipped by
+        # every resume forever (a verdict the sweep legitimately
+        # stamped against the completed commit is also dropped; that
+        # only costs one re-hash at the next sweep)
+        clear_integrity_sidecars(save_name)
         Checkpointer._maybe_corrupt(save_name, step, tier=tier.name)
+        Checkpointer._maybe_flip(save_name, step, tier=tier.name)
 
     def _commit_job(self, jobs, step, meta, background=True):
         """Writer body: wait out the storage write, then commit
@@ -522,6 +544,7 @@ def build_checkpoint_manager(
     ``ckpt_local_interval``) with tight retention."""
     mode = parallel_mode or cfg.sharding_strategy
     verify = bool(getattr(cfg, "checkpoint_verify", True))
+    full_checksums = bool(getattr(cfg, "ckpt_full_checksums", True))
     tiers = []
     local_dir = getattr(cfg, "ckpt_local_dir", "") or ""
     local_interval = int(getattr(cfg, "ckpt_local_interval", 0) or 0)
@@ -548,6 +571,7 @@ def build_checkpoint_manager(
                 rank=rank,
                 report_fn=report_fn,
                 verify=verify,
+                full_checksums=full_checksums,
             )
         )
     tiers.append(
@@ -560,6 +584,7 @@ def build_checkpoint_manager(
             rank=rank,
             report_fn=report_fn,
             verify=verify,
+            full_checksums=full_checksums,
         )
     )
     mgr = AsyncCheckpointManager(

@@ -185,6 +185,30 @@ class TrainConfig:
     loader_worker_restarts: int = 2  # worker restarts before the error surfaces
     loader_restart_backoff_s: float = 1.0  # initial worker-restart backoff
     checkpoint_verify: bool = True  # verify manifests on load, fall back on corruption
+    # State integrity (docs/checkpointing.md "State integrity").
+    # ckpt_full_checksums: manifest v2 — chunked content checksums for
+    # LARGE array files, computed on the async manager's background
+    # writer (blocking snapshot time unchanged); off degrades large
+    # files to size-only verification like a version-1 manifest.
+    ckpt_full_checksums: bool = True
+    # Background checkpoint scrubber cadence (steps; 0 disables): rank 0
+    # re-verifies every committed checkpoint across all tiers on a
+    # daemon thread, quarantining a corrupt step dir (sidecar + one
+    # actionable line) so resume routes around it BEFORE a crash needs
+    # it. Verdicts are cached by manifest digest — repeat sweeps hash
+    # only new commits. scripts/scrub_checkpoints.py is the fleet CLI.
+    scrub_interval_steps: int = 0
+    # Cross-replica divergence detection cadence (steps; 0 disables;
+    # multi-process runs only): at report boundaries every process
+    # fingerprints its window scalars + a whole-state checksum (a
+    # single sentinel leaf could not see SDC elsewhere in the tree;
+    # see resilience/divergence.py) and
+    # compares across processes via one tiny allgather — disagreement
+    # means a replicated train state silently diverged (SDC / broken
+    # reduce) and exits classified ``state_divergence``; the supervisor
+    # then relaunches under the verified-resume rule
+    # (docs/resilience.md "Cross-replica divergence detection").
+    divergence_check_interval: int = 0
     faults: str = ""  # fault-injection spec (testing only; see resilience/faults.py)
 
     # checkpointing (docs/checkpointing.md). The async manager snapshots

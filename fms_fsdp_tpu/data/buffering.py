@@ -182,6 +182,12 @@ class CheckpointDataset(WrapperDataset):
     directory — a restarted job resumes itself; an external load path
     resets the step count)."""
 
+    # advertises the empty-path fresh-start marker contract to
+    # Checkpointer.load (load_from_path("") = "the trainer resolved a
+    # from-scratch start"); loaders without this flag are left untouched
+    # exactly as before the marker existed
+    supports_fresh_start = True
+
     def __init__(
         self,
         dataset: StatefulDataset,
@@ -296,6 +302,30 @@ class CheckpointDataset(WrapperDataset):
         # stream exactly the committed stream (scripts/chaos_soak.py
         # pins bit-identity on this). The flag suppresses setup()'s
         # auto-load, which would clobber the explicit restore.
+        #
+        # An EMPTY path is the same contract's other verdict: the
+        # trainer resolved NO restorable checkpoint (every candidate
+        # torn, quarantined, or absent) and the model starts from
+        # scratch — so must the walk THROUGH THIS RUN'S OWN SAVE DIR.
+        # Loader auto-saves land there on the dataset's own interval
+        # cadence whether or not the model commit ever completed, so
+        # without this marker setup()'s auto-load would resume the walk
+        # from a stale auto-save under fresh model state (model@0 +
+        # loader@N), shifting the consumed stream of the entire
+        # restarted run. An EXTERNAL load root (resuming_dataset=True,
+        # continued pretraining) is still honored below: that loader
+        # state belongs to a different run and cannot outrun this run's
+        # model state.
+        if path == "":
+            self._explicit_restore = True
+            self.setup()
+            self.report(
+                "  Dataset: trainer resolved a from-scratch start; "
+                "ignoring loader auto-saves in the save directory."
+            )
+            if os.path.abspath(self.load_path) != os.path.abspath(self.path):
+                self._load_external()
+            return
         resolved = os.path.abspath(path)
         own_roots = {
             os.path.abspath(p)
@@ -328,13 +358,24 @@ class CheckpointDataset(WrapperDataset):
                 f"  Dataset: Detected a checkpoint in the save directory "
                 f"{save_path}. Restoring from this checkpoint."
             )
-            path = save_path
-        else:
-            load_path = self._validate_ckp_path(self.load_path, True)
-            if len(load_path) == 0:
-                return
-            path = load_path
-            self.step = 0  # external checkpoint: step restarts
+            start = time.time()
+            self.dataset.load_from_path(save_path)
+            self.report(
+                f"Dataset checkpoint loaded! Load time: {time.time() - start}"
+            )
+            return
+        self._load_external()
+
+    def _load_external(self):
+        """Restore from the EXTERNAL load root (``resuming_dataset=True``
+        continued pretraining): that loader state belongs to a different
+        run, so the step count restarts. Shared by the auto-detect path
+        and the fresh-start marker (which only rules out this run's own
+        save dir)."""
+        load_path = self._validate_ckp_path(self.load_path, True)
+        if len(load_path) == 0:
+            return
+        self.step = 0  # external checkpoint: step restarts
         start = time.time()
-        self.dataset.load_from_path(path)
+        self.dataset.load_from_path(load_path)
         self.report(f"Dataset checkpoint loaded! Load time: {time.time() - start}")

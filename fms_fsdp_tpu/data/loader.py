@@ -216,6 +216,11 @@ class StatefulDataLoader:
     owns an inflated rank and saves its own ``loader_state_<rank>`` file.
     """
 
+    # forwards the empty-path fresh-start marker to its pipelines
+    # (get_data_loader always builds CheckpointDataset outermost, which
+    # implements it; see data/buffering.py)
+    supports_fresh_start = True
+
     # shutdown escalation budget (seconds): cooperative stop -> join ->
     # SIGTERM -> join -> SIGKILL -> reap. Class attrs so tests (and
     # latency-sensitive callers) can tighten the bounds.

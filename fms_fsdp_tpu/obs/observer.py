@@ -85,9 +85,17 @@ class Observer:
         # ici_collective_s / dcn_collective_s split; None (single-slice)
         # leaves both fields 0.0
         self._collective_probe: Optional[Callable[[], None]] = None
+        # set by the train loop when the state-integrity layer is armed
+        # (utils/train_utils.py): a callable draining the verification
+        # window for the v8 integrity_verify_s / scrub_verified /
+        # divergence_checks fields; absent -> 0 / 0 / 0.0
+        self._integrity_stats: Optional[Callable[[], Dict]] = None
 
     def attach_checkpoint_stats(self, fn: Callable[[], Dict]) -> None:
         self._ckpt_stats = fn
+
+    def attach_integrity_stats(self, fn: Callable[[], Dict]) -> None:
+        self._integrity_stats = fn
 
     def attach_collective_probe(self, fn: Optional[Callable[[], None]]) -> None:
         self._collective_probe = fn
@@ -158,6 +166,11 @@ class Observer:
         # committed-save counters into the registry here on the main
         # thread, so they land in THIS record's extras
         ckpt_stats = self._ckpt_stats() if self._ckpt_stats else {}
+        # integrity stats BEFORE the snapshot too: the provider drains
+        # the scrubber/verify event buffer into the registry counters
+        # (integrity.shard_corrupt_detected) so detections land in THIS
+        # record's extras
+        integ = self._integrity_stats() if self._integrity_stats else {}
         extras = dict(self.registry.snapshot())
         if extra:
             extras.update(extra)
@@ -198,6 +211,11 @@ class Observer:
             # probe; 0.0 without one — single-slice runs)
             "ici_collective_s": window.get("ici_collective", 0.0),
             "dcn_collective_s": window.get("dcn_collective", 0.0),
+            # v8: state-integrity accounting (scrub + divergence layer;
+            # 0 / 0 / 0.0 when the layer is not armed)
+            "integrity_verify_s": float(integ.get("verify_s", 0.0)),
+            "scrub_verified": int(integ.get("scrub_verified", 0)),
+            "divergence_checks": int(integ.get("divergence_checks", 0)),
             "wall_s": wall,
             "goodput": goodput_w,
             "goodput_overall": goodput_all,

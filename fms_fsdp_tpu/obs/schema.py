@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -77,6 +77,21 @@ SCHEMA_FIELDS = {
     # data.mix.<corpus>.tokens_seen gauges additionally ride in
     # ``extra``.
     "data_mix": ("map", False),
+    # v8: state-integrity accounting (docs/checkpointing.md "State
+    # integrity"). integrity_verify_s is the window's wall seconds spent
+    # in manifest verification (scrubber sweeps + restore-walk
+    # verifies, drained from the background event buffer);
+    # scrub_verified is the cumulative count of checkpoints this
+    # process has confirmed content-verified (fresh hash or matching
+    # cached verdict); divergence_checks is the cumulative count of
+    # cross-replica fingerprint compares performed
+    # (resilience/divergence.py). Detections ride in ``extra`` as the
+    # integrity.shard_corrupt_detected / integrity.divergence_detected
+    # counters. Runs without the integrity layer armed report 0 / 0 /
+    # 0.0.
+    "integrity_verify_s": ("float", True),
+    "scrub_verified": ("int", True),
+    "divergence_checks": ("int", True),
     # v6: self-healing supervisor accounting (docs/resilience.md
     # "Self-healing supervisor"). The relaunched run reads the
     # supervisor's restart ledger (FMS_RESTART_LEDGER) at observer
@@ -135,6 +150,10 @@ SCHEMA_DIGESTS = {
     # v7: + data_mix (per-corpus tokens_seen / target vs realized share /
     # quarantined flag from the weighted multi-corpus mixing layer)
     7: "fed0cc09460e2c7da58cf4519e40e8d4e0ff6c25874b65fbd9d0e7f44ff83af9",
+    # v8: + integrity_verify_s / scrub_verified / divergence_checks
+    # (state-integrity layer: manifest verification time, scrub-verified
+    # checkpoint count, cross-replica fingerprint compares)
+    8: "96ce592c9a1e990018a24d93757370679c594bfac64269b225cd2ff635ee4a3e",
 }
 
 

@@ -27,8 +27,17 @@ non-finite batch. The preemption half lives in
 - ``retry``     — bounded retry-with-backoff helpers and the retrying
   shard-file handler wrapper;
 - ``integrity`` — per-checkpoint manifests (file list + sizes +
-  checksums of small metadata files) written at commit time and
-  verified on load.
+  full-content checksums: whole-file for small files, chunked for large
+  array shards — manifest v2) written at commit time and verified on
+  load and by the scrubber;
+- ``scrub``     — the checkpoint scrubber: background re-verification
+  of committed checkpoints, quarantine sidecars the fallback chain
+  skips, digest-cached verdicts, and the verified-resume policy
+  (docs/checkpointing.md "State integrity");
+- ``divergence`` — cross-replica divergence detection: report-cadence
+  fingerprint compares proving the dcn-replicated train states still
+  agree, raising ``StateDivergenceError`` (exit class
+  ``state_divergence``) when a replica silently diverged.
 
 Recovery semantics are documented in docs/resilience.md.
 """
@@ -47,21 +56,41 @@ from fms_fsdp_tpu.resilience.faults import (
     fire_fault,
     maybe_raise_fault,
 )
+from fms_fsdp_tpu.resilience.divergence import (
+    StateDivergenceError,
+    check_divergence,
+)
 from fms_fsdp_tpu.resilience.guards import AnomalyGuard, StepWatchdog
 from fms_fsdp_tpu.resilience.integrity import (
     verify_manifest,
     write_manifest,
 )
 from fms_fsdp_tpu.resilience.retry import RetryingShardHandler, retry_call
+from fms_fsdp_tpu.resilience.scrub import (
+    CheckpointScrubber,
+    cached_verify,
+    is_quarantined,
+    quarantine_checkpoint,
+    scrub_checkpoint,
+    scrub_verdict,
+)
 from fms_fsdp_tpu.resilience.slices import SliceHealthMonitor, SliceLostError
 
 __all__ = [
     "AnomalyGuard",
+    "CheckpointScrubber",
     "EXIT_CODES",
     "RetryingShardHandler",
     "SliceHealthMonitor",
     "SliceLostError",
+    "StateDivergenceError",
     "StepWatchdog",
+    "cached_verify",
+    "check_divergence",
+    "is_quarantined",
+    "quarantine_checkpoint",
+    "scrub_checkpoint",
+    "scrub_verdict",
     "classified_exit",
     "classify_exit",
     "classify_world",

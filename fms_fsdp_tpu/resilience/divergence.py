@@ -1,0 +1,323 @@
+"""Cross-replica divergence detection: prove the dcn-replicated train
+states still agree.
+
+On a multi-slice mesh (parallel/mesh.py) every param/optimizer leaf is
+REPLICATED across slices — GSPMD assumes the replicas are bit-identical
+and no collective ever checks it. Silent data corruption (a defective
+chip, a broken reduce, a flipped DMA) can diverge one slice's replica
+and the run keeps training, healthy-looking, on two different models:
+the post-reduce loss mixes both contributions and reads the same
+everywhere, so the scalars the operator watches cannot catch it.
+
+This module catches it at report cadence, for the cost of one pass of
+on-device integer arithmetic and one tiny allgather:
+
+- each process computes a **fingerprint**: the window's loss and
+  grad-norm scalars (bit-patterns, not approximate compares) plus a
+  jitted **whole-state checksum** — every leaf of the train state
+  (params AND optimizer moments: opt-moment SDC reaches params only a
+  step later, and by then a commit may have persisted the poison)
+  bitcast to uint32 and wrap-summed on device, reduced within the
+  slice, REPLICATED (i.e. redundantly recomputed, never communicated)
+  across slices. One scalar crosses to the host per check. A
+  single-leaf digest would not do: the gradient all-reduce hands every
+  replica the SAME update, so corruption stays confined to exactly the
+  leaves it hit and never spreads to a sentinel leaf — the checksum
+  must cover the whole tree;
+- fingerprints cross the wire via ``multihost_utils.process_allgather``
+  (the same collective helper the checkpoint gate uses), packed into a
+  fixed-shape int64 row — no variable-size payloads on the hot path;
+- **every value must agree across every process**: the scalars are
+  post-reduce replicated values, and the checksum is a per-replica
+  recomputation of a nominally replicated quantity — any disagreement
+  means a replica's state (or the reduce itself) is broken.
+
+Disagreement means a replica silently diverged. That is not retryable
+— every later step compounds it — so the check raises
+:class:`StateDivergenceError`, which the entries' ``classified_exit``
+maps to the ``state_divergence`` registry exit code; the run
+supervisor's policy relaunches through elastic resume under the
+VERIFIED-resume rule (restore only a scrub-verified checkpoint — the
+newest one may already hold the diverged replica's poison;
+resilience/scrub.py).
+
+Fault site ``sdc_grad_flip`` injects exactly this failure — HOST-side,
+at the ``_train_loop`` step boundary (utils/train_utils.py; the NOTE in
+train/step.py explains why the in-trace site was abandoned): one
+process's gradient is perturbed on a chosen step, its slice's replica
+walks away, and the next fingerprint compare must catch it
+(scripts/chaos_soak.py proves detection + verified-resume recovery end
+to end).
+"""
+
+import hashlib
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_TOTAL_CHECKS = 0
+
+
+class StateDivergenceError(RuntimeError):
+    """Raised when cross-replica fingerprints disagree: a replica's
+    train state has silently diverged (SDC or a broken reduce). Mapped
+    to the ``state_divergence`` exit code by ``classified_exit``."""
+
+
+def total_checks() -> int:
+    """Divergence checks performed by this process (obs schema v8
+    ``divergence_checks``)."""
+    return _TOTAL_CHECKS
+
+
+def reset_checks() -> None:
+    global _TOTAL_CHECKS
+    _TOTAL_CHECKS = 0
+
+
+def _digest64(payload: bytes) -> int:
+    """First 8 bytes of sha256 as a signed int64 (allgather-friendly)."""
+    return int.from_bytes(
+        hashlib.sha256(payload).digest()[:8], "big", signed=True
+    )
+
+
+def _leaf_by_size(state, largest: bool) -> Tuple[str, object]:
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(state["params"])[0]
+    assert leaves, "empty param tree"
+    keyed = sorted(
+        leaves,
+        key=lambda kv: (
+            int(np.prod(kv[1].shape)) * np.dtype(kv[1].dtype).itemsize,
+            jax.tree_util.keystr(kv[0]),
+        ),
+        reverse=largest,
+    )
+    path, leaf = keyed[0]
+    return jax.tree_util.keystr(path), leaf
+
+
+_CHECKSUM_JIT = None
+
+
+def state_checksum(state) -> int:
+    """Per-replica whole-state checksum: EVERY leaf of the train state
+    — params, optimizer moments, step, amax histories — bitcast to
+    uint32 and wrap-summed (mod 2^32) on device. Optimizer state is
+    covered deliberately: SDC in a replicated Adam moment reaches
+    params only one step later, and a commit boundary in between
+    persists the poison into a checkpoint every replica then restores
+    uniformly — the compare must see it while it still disagrees. The
+    sum reduces over the SHARDED axes (an in-slice collective); across
+    the replicated dcn axis each replica redundantly recomputes it from
+    its own bytes — which is the point: a diverged replica computes a
+    different number, and the fetched scalar is this process's
+    replica's answer.
+
+    Exact integer arithmetic (no float rounding to hide a bit-flip),
+    order-independent (safe under any reduction tiling), one device
+    pass, one scalar to the host."""
+    import jax
+    import jax.numpy as jnp
+
+    global _CHECKSUM_JIT
+    if _CHECKSUM_JIT is None:
+
+        def _bits32(leaf):
+            leaf = jnp.asarray(leaf)
+            if leaf.dtype == jnp.bool_:
+                leaf = leaf.astype(jnp.uint8)
+            dt = jnp.dtype(leaf.dtype)
+            if dt.itemsize == 4:
+                return jax.lax.bitcast_convert_type(leaf, jnp.uint32)
+            if dt.itemsize == 2:
+                return jax.lax.bitcast_convert_type(
+                    leaf, jnp.uint16
+                ).astype(jnp.uint32)
+            if dt.itemsize == 1:
+                return jax.lax.bitcast_convert_type(
+                    leaf, jnp.uint8
+                ).astype(jnp.uint32)
+            # 8-byte leaves (x64-enabled runs): fold halves
+            halves = jax.lax.bitcast_convert_type(
+                leaf.reshape(-1), jnp.uint32
+            )
+            return halves
+
+        @jax.jit
+        def _ck(tree):
+            total = jnp.uint32(0)
+            for leaf in jax.tree.leaves(tree):
+                total = total + jnp.sum(
+                    _bits32(leaf), dtype=jnp.uint32
+                )
+            return total
+
+        _CHECKSUM_JIT = _ck
+    return int(jax.device_get(_CHECKSUM_JIT(state)))
+
+
+# back-compat name (the checksum has always taken the full state dict;
+# it now also COVERS the full state, optimizer moments included)
+params_checksum = state_checksum
+
+
+def inject_sdc(state, scale: float = 1.5):
+    """The ``sdc_grad_flip`` fault-site payload (train loop, step
+    boundary): scale THIS process's addressable shards of the largest
+    param leaf, leaving every other process's replica untouched — the
+    observable effect of an update computed from a corrupted gradient
+    on one replica. Deliberately host-side: any in-trace injection,
+    even an exact multiply-by-1.0, shifts XLA's fusion/precision
+    decisions and diverges the compiled program's rounding on EVERY
+    step — the injection must corrupt exactly one replica at exactly
+    one step and nothing else. Returns the new state (old leaf buffers
+    are dropped; the next donated step consumes the rebuilt array)."""
+    import jax
+
+    key, leaf = _leaf_by_size(state, largest=True)
+    shards = sorted(leaf.addressable_shards, key=lambda s: str(s.index))
+    new_shards = [
+        jax.device_put(
+            (np.asarray(s.data) * scale).astype(leaf.dtype), s.device
+        )
+        for s in shards
+    ]
+    new_leaf = jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, new_shards
+    )
+
+    def replace(path, old):
+        return (
+            new_leaf if jax.tree_util.keystr(path) == key else old
+        )
+
+    params = jax.tree_util.tree_map_with_path(replace, state["params"])
+    return dict(state, params=params), key
+
+
+def scalar_digest(loss: float, grad_norm: float) -> int:
+    """Bit-pattern digest of the window's post-reduce scalars. These are
+    replicated values: any healthy world fetches the same bits on every
+    process, so equality (not tolerance) is the correct compare."""
+    return _digest64(struct.pack("<dd", float(loss), float(grad_norm)))
+
+
+def _minority(labels, values):
+    """Attribute a fingerprint disagreement: the MINORITY value's label
+    set is the suspect (with >=3 participants the corrupted replica is
+    outvoted; blaming "whoever differs from row 0" would name the
+    healthy peers whenever process 0 is the corrupt one). Returns
+    (sorted minority labels, None) — or (None, {value: labels}) on an
+    exact tie, where no side can be blamed and the report must show the
+    split symmetrically."""
+    groups: dict = {}
+    for lab, val in zip(labels, values):
+        groups.setdefault(int(val), set()).add(int(lab))
+    sizes = sorted(len(m) for m in groups.values())
+    if len(groups) > 1 and sizes.count(sizes[-1]) == 1:
+        majority_val = max(groups, key=lambda v: len(groups[v]))
+        odd = sorted(
+            lab
+            for val, mem in groups.items()
+            if val != majority_val
+            for lab in mem
+        )
+        return odd, None
+    return None, {v: sorted(m) for v, m in sorted(groups.items())}
+
+
+def check_divergence(
+    state,
+    loss: float,
+    grad_norm: float,
+    step: int,
+    cfg=None,
+    registry=None,
+    report=print,
+) -> bool:
+    """One divergence check (call at report cadence, every rank, same
+    step — the allgather is collective). Returns True when all
+    fingerprints agree; raises :class:`StateDivergenceError` (after one
+    actionable line and the ``integrity.divergence_detected`` counter)
+    when a replica disagrees. Single-process worlds are a no-op."""
+    global _TOTAL_CHECKS
+    import jax
+
+    if jax.process_count() == 1:
+        return True
+    from jax.experimental import multihost_utils
+
+    from fms_fsdp_tpu.parallel.mesh import process_slice_context
+
+    _, slice_idx = process_slice_context(cfg)
+    row = np.array(
+        [
+            int(jax.process_index()),
+            int(slice_idx),
+            scalar_digest(loss, grad_norm),
+            state_checksum(state) & 0xFFFFFFFF,
+        ],
+        np.int64,
+    )
+    gathered = np.asarray(multihost_utils.process_allgather(row)).reshape(
+        -1, 4
+    )
+    _TOTAL_CHECKS += 1
+
+    problems: List[str] = []
+    scal = gathered[:, 2]
+    if not np.all(scal == scal[0]):
+        odd, tied = _minority(gathered[:, 0], scal)
+        problems.append(
+            (
+                f"loss/grad-norm fingerprints disagree across processes "
+                f"(split {tied} — no majority)"
+                if odd is None
+                else f"loss/grad-norm fingerprints disagree across "
+                f"processes (minority processes {odd} differ from the "
+                f"majority)"
+            )
+            + " — the post-reduce scalars are replicated values and "
+            "must be bit-identical"
+        )
+    cks = gathered[:, 3]
+    if not np.all(cks == cks[0]):
+        odd, tied = _minority(gathered[:, 1], cks)
+        problems.append(
+            (
+                f"whole-state checksums disagree (slices split {tied} "
+                f"— no majority)"
+                if odd is None
+                else f"whole-state checksums disagree (minority "
+                f"slices {odd} differ from the majority)"
+            )
+            + " — a replicated train state has silently diverged"
+        )
+    if not problems:
+        return True
+    if registry is not None:
+        registry.counter("integrity.divergence_detected").add()
+    report(
+        f"INTEGRITY: cross-replica state divergence detected at step "
+        f"{step}: {problems[0]} (integrity.divergence_detected; "
+        f"relaunch will resume from the last scrub-verified checkpoint)"
+    )
+    raise StateDivergenceError(
+        f"cross-replica state divergence at step {step}: "
+        + "; ".join(problems)
+    )
+
+
+def divergence_due(
+    step: int, last_checked: Optional[int], interval: int
+) -> bool:
+    """Cadence gate the train loop consults at report boundaries:
+    ``interval`` steps (the ``divergence_check_interval`` knob) must
+    have passed since the last check. 0 disables."""
+    if interval <= 0:
+        return False
+    return last_checked is None or (step - last_checked) >= interval

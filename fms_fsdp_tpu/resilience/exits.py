@@ -47,6 +47,15 @@ corpus_loss         8     CorpusLossError through the entry wrapper: the
                           the LAST corpus always breaches the floor) — the
                           data is gone, not the worker, so the supervisor
                           relaunches expecting the corpus restored
+state_divergence    9     StateDivergenceError through the entry wrapper
+                          (resilience/divergence.py): the report-cadence
+                          cross-replica fingerprint compare found a
+                          replicated train state disagreeing across
+                          processes — SDC or a broken reduce. The state in
+                          memory (and possibly the newest checkpoint) is
+                          suspect, so the supervisor's policy relaunches
+                          under the VERIFIED-resume rule: restore only from
+                          a scrub-verified checkpoint (FMS_VERIFIED_RESUME)
 ==================  ====  ===================================================
 
 ``classify_world`` merges one incarnation's per-host exit codes into the
@@ -83,6 +92,7 @@ EXIT_CODES: Dict[str, int] = {
     "preempted": 6,
     "injected_kill": 7,
     "corpus_loss": 8,
+    "state_divergence": 9,
 }
 
 # most-causal-first: when one incarnation's hosts exit with different
@@ -94,6 +104,12 @@ EXIT_CODES: Dict[str, int] = {
 CLASSIFY_PRIORITY = (
     "loader_death",
     "corpus_loss",
+    # every process detects divergence at the same collective compare
+    # and exits 9 together, but a rank that was wedged inside the
+    # allgather when its peers bailed can echo as a watchdog stall or
+    # slice loss — the divergence is the cause and must pick the
+    # (verified-resume) restart policy
+    "state_divergence",
     "anomaly_abort",
     "slice_loss",
     "watchdog_stall",
@@ -182,6 +198,12 @@ def classify_exception(e: BaseException) -> Optional[str]:
         # BEFORE the isinstance sweep order matters only across types
         # that nest; CorpusLossError and LoaderWorkerError are disjoint
         checks.append((CorpusLossError, "corpus_loss"))
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from fms_fsdp_tpu.resilience.divergence import StateDivergenceError
+
+        checks.append((StateDivergenceError, "state_divergence"))
     except Exception:  # noqa: BLE001
         pass
     for typ, name in checks:

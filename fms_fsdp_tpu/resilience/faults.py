@@ -38,6 +38,27 @@ ckpt_durable_write
                 bounded commit retry; an unbounded fault on the durable
                 tier exhausts it and triggers the degrade-to-local path
                 (checkpoint.durable_degraded counter)
+ckpt_shard_corrupt
+                Checkpointer.save / the async writer, after the commit
+                marker: flips ``bytes=N`` (default 4) at the midpoint of
+                a manifest-recorded file (``file=<substring>`` selects;
+                largest match first, so the default hits an array
+                shard) WITHOUT changing its size — the silent bit-rot /
+                SDC-storage class that passes every size check and only
+                the manifest-v2 content checksums or the scrubber catch
+                (the committed dir must quarantine and resume must
+                route around it)
+sdc_grad_flip   the train loop's step boundary, host-side (the
+                observable effect of an update computed from a
+                corrupted gradient): scales ONE process's addressable
+                shards of the largest param leaf by ``scale`` (default
+                1.5) on loop step ``step``, ``proc=P`` selecting the
+                victim (resilience/divergence.py::inject_sdc — kept
+                OUT of the trace: any per-process program difference
+                shifts XLA rounding on every step). That process's
+                slice silently diverges from its replicas; the
+                report-cadence cross-replica fingerprint compare must
+                detect it and exit classified ``state_divergence``
 slice_kill      the train loop's step boundary, before the step is
                 dispatched (hard-exits the process with ``code``,
                 default the ``injected_kill`` registry code,
@@ -71,7 +92,7 @@ variable or ``TrainConfig.faults``::
 
 Filter params are matched against the call-site context before firing:
 ``path`` / ``op`` / ``tier`` / ``corpus`` (substring), ``worker`` /
-``batch`` / ``step`` / ``slice`` (equality). A configured filter the call site does not supply in its
+``batch`` / ``step`` / ``slice`` / ``proc`` (equality). A configured filter the call site does not supply in its
 context is a non-match (the fault does not fire) — a typo'd filter must
 never degrade into firing everywhere.
 ``times=N`` caps the number of fires (per process; counters are
@@ -95,6 +116,7 @@ ENV_VAR = "FMS_FAULTS"
 # params that filter whether a call-site context matches (vs payload)
 _FILTER_KEYS = (
     "path", "op", "worker", "batch", "step", "tier", "slice", "corpus",
+    "proc",
 )
 
 

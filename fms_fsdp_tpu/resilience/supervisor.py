@@ -85,6 +85,7 @@ from fms_fsdp_tpu.resilience.exits import (
     EXIT_CODES,
     classify_world,
 )
+from fms_fsdp_tpu.resilience.scrub import ENV_VERIFIED_RESUME
 
 LEDGER_VERSION = 1
 
@@ -93,13 +94,16 @@ LEDGER_VERSION = 1
 class RestartPolicy:
     """Per-exit-class restart decision: whether to relaunch, the backoff
     base (doubles per consecutive no-progress restart, like every other
-    backoff in resilience/), an extra fixed cooldown, and whether the
-    next incarnation drops a fault domain."""
+    backoff in resilience/), an extra fixed cooldown, whether the next
+    incarnation drops a fault domain, and whether it must resume under
+    the VERIFIED-resume rule (restore only a scrub-verified checkpoint —
+    the state-divergence policy, resilience/divergence.py)."""
 
     restart: bool = True
     backoff: bool = True
     cooldown_s: float = 0.0
     drop_slice: bool = False
+    verified_resume: bool = False
 
 
 def default_policies(
@@ -119,6 +123,12 @@ def default_policies(
         # worker: relaunch with backoff expecting the corpus restored —
         # a still-dead corpus re-exits and the crash-loop guard ends it
         "corpus_loss": RestartPolicy(),
+        # a replica's state silently diverged (SDC / broken reduce): the
+        # newest checkpoint may hold the diverged replica's poison, so
+        # every later incarnation resumes from the last SCRUB-VERIFIED
+        # checkpoint (FMS_VERIFIED_RESUME exported to the children),
+        # never trust-on-size the newest
+        "state_divergence": RestartPolicy(verified_resume=True),
         "injected_kill": RestartPolicy(),
         "error": RestartPolicy(),
     }
@@ -214,6 +224,11 @@ class RunSupervisor:
             anomaly_cooldown_s=anomaly_cooldown_s, on_slice_loss=on_slice_loss
         )
         self._launch = launch or self._launch_subprocesses
+        # sticky once set (a state_divergence classification): every
+        # later incarnation restores only scrub-verified checkpoints —
+        # once a replica has silently diverged, "newest" is no longer a
+        # trustworthy resume point for the rest of this run
+        self._verified_resume = False
         self._clock = clock
         self._sleep = sleep
         self._log = log or (lambda msg: print(f"[supervisor] {msg}", flush=True))
@@ -315,6 +330,8 @@ class RunSupervisor:
                     argv, env, cwd = list(spec), dict(os.environ), None
                 env[ENV_RUN_ID] = run_id
                 env[ENV_LEDGER] = os.path.abspath(self.ledger_path)
+                if self._verified_resume:
+                    env[ENV_VERIFIED_RESUME] = "1"
                 out = None
                 if self.log_dir:
                     os.makedirs(self.log_dir, exist_ok=True)
@@ -391,6 +408,10 @@ class RunSupervisor:
                 "num_slices": self.num_slices,
                 "restarts": led["restarts"],
                 "ledger": led,
+                # custom launchers (tests, fleet builders) see the
+                # verified-resume demand too; the default subprocess
+                # launcher exports FMS_VERIFIED_RESUME itself
+                "verified_resume": self._verified_resume,
             }
             specs = self.build_command(ctx)
             entry = _Entry(
@@ -443,6 +464,16 @@ class RunSupervisor:
                 f"attempt {attempt} exited {entry.exit_codes} -> "
                 f"classified {cls!r} (heartbeat step {entry.step_at_exit})"
             )
+            if policy.verified_resume and not self._verified_resume:
+                self._verified_resume = True
+                entry.note = (
+                    entry.note + " " if entry.note else ""
+                ) + (
+                    "state divergence: all further incarnations resume "
+                    "under the verified-resume rule (scrub-verified "
+                    "checkpoints only)"
+                )
+                self._log(entry.note)
             if not policy.restart:
                 return self._finish("gave_up", run_id)
 

@@ -266,6 +266,16 @@ def make_train_step(
 
     guard_updates = bool(getattr(cfg, "anomaly_skip_updates", True))
     nan_fault = fault_params("nan_loss")
+    # NOTE: the sdc_grad_flip fault site deliberately does NOT inject
+    # here. Any trace-level difference — even an exact multiply-by-1.0
+    # gated to one process, or the same op armed identically everywhere
+    # — changes XLA's fusion/precision decisions and shifts the
+    # compiled program's rounding at bf16 level, silently diverging
+    # replicas (or the armed run from the clean run) on every step, not
+    # just the injected one. The injection lives host-side at the train
+    # loop's step boundary (resilience/divergence.py::inject_sdc),
+    # where it perturbs one process's addressable shards with ZERO
+    # program changes.
 
     from fms_fsdp_tpu.models import MambaConfig, MixtralConfig
 

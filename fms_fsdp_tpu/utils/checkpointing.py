@@ -131,12 +131,19 @@ def scan_topology(candidates, verify=True):
     matches the checkpoint a restore will actually read (a corrupt
     newest checkpoint with intact metadata must not set a policy the
     restore's fallback then contradicts)."""
-    from fms_fsdp_tpu.resilience.integrity import verify_manifest
+    from fms_fsdp_tpu.resilience.scrub import (
+        cached_verify,
+        verified_resume_active,
+    )
 
     for cand in candidates:
         if os.path.isfile(cand):
             break  # single-file checkpoints carry no metadata
-        if verify and not verify_manifest(cand)[0]:
+        # verdict-cached verification (resilience/scrub.py): a
+        # quarantined dir is skipped outright, a scrub-verified one
+        # costs a digest read, and a fresh verify here is memoized so
+        # load()'s walk over the same candidates never re-hashes it
+        if (verify or verified_resume_active()) and not cached_verify(cand)[0]:
             continue  # load() will reject it and fall back too
         try:
             with open(os.path.join(cand, "metadata.json")) as f:
@@ -182,6 +189,7 @@ class Checkpointer:
         local_rank: int = 0,
         report_fn=None,
         verify: bool = True,
+        full_checksums: bool = True,
     ):
         self.max_ckps = n_to_save
         self.rank = jax.process_index() if rank is None else rank
@@ -189,6 +197,10 @@ class Checkpointer:
         # verify per-checkpoint manifests on load and fall back to the
         # next-newest committed checkpoint on corruption (resilience layer)
         self.verify = verify
+        # manifest v2 full-content coverage: chunked checksums for large
+        # array files (the ckpt_full_checksums knob); off degrades large
+        # files to size-only verification like a version-1 manifest
+        self.full_checksums = bool(full_checksums)
         self.ckp_path = os.path.join(ckpdir, "checkpoints/")
         os.makedirs(self.ckp_path, exist_ok=True)
         assert parallel_mode in ["fsdp", "hsdp", "ddp", "tp"]
@@ -329,6 +341,13 @@ class Checkpointer:
         # real checkpoints. Keep entries that actually hold MODEL
         # state — the folder interleaves loader auto-save dirs
         # (loader_state only, no metadata.json) with model checkpoints.
+        # Quarantined dirs (the scrubber's integrity_quarantine.json
+        # sidecar, resilience/scrub.py) are dropped here, at the single
+        # choke point every walk shares — load's fallback chain,
+        # resume_topology, and the multi-tier merge all route around a
+        # known-corrupt step dir without re-reading a byte of it.
+        from fms_fsdp_tpu.resilience.scrub import is_quarantined
+
         candidates = sorted(
             (
                 os.path.join(path, x)
@@ -341,7 +360,11 @@ class Checkpointer:
         return [
             cand
             for cand in candidates
-            if os.path.isfile(cand) or "metadata.json" in safe_listdir(cand)
+            if os.path.isfile(cand)
+            or (
+                "metadata.json" in safe_listdir(cand)
+                and not is_quarantined(cand)
+            )
         ]
 
     def _validate_ckp_path(self, path):
@@ -438,7 +461,29 @@ class Checkpointer:
             if os.path.isfile(ckp_to_remove):
                 ckp_to_remove.unlink()
             else:
-                shutil.rmtree(ckp_to_remove)
+                try:
+                    shutil.rmtree(ckp_to_remove)
+                except OSError:
+                    # the rank-0 scrubber thread can stamp a verdict/
+                    # quarantine sidecar into this dir between rmtree's
+                    # directory scan and its final rmdir (ENOTEMPTY):
+                    # drop the sidecars and retry once; a second failure
+                    # must not kill the save path over retention
+                    # housekeeping — leave the dir for the next pass
+                    from fms_fsdp_tpu.resilience.scrub import (
+                        clear_integrity_sidecars,
+                    )
+
+                    clear_integrity_sidecars(str(ckp_to_remove))
+                    try:
+                        shutil.rmtree(ckp_to_remove)
+                    except OSError as e:
+                        self.report(
+                            f"WARNING: retention cleanup of "
+                            f"{ckp_to_remove} failed ({e}); retrying at "
+                            f"the next save"
+                        )
+                        break
         # non-model step dirs split two ways:
         # - loader-only auto-save dirs (loader_state files, no model
         #   state payload): CheckpointDataset resumes from the newest of
@@ -567,7 +612,15 @@ class Checkpointer:
             if dataloader is not None:
                 dataloader.save_to_path(save_name)
             if self.rank == 0:
-                write_manifest(save_name)
+                from fms_fsdp_tpu.resilience.scrub import (
+                    clear_integrity_sidecars,
+                )
+
+                # a re-commit into a previously-quarantined step dir
+                # (fallback resume trained back past it) carries fresh
+                # content: stale verdicts must not outlive the bytes
+                clear_integrity_sidecars(save_name)
+                write_manifest(save_name, full_checksums=self.full_checksums)
                 metadata["step"] = step
                 stamp_topology(metadata, self.fingerprint, dataloader)
                 meta_path = os.path.join(save_name, "metadata.json")
@@ -576,7 +629,13 @@ class Checkpointer:
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(meta_path + ".tmp", meta_path)
+                # re-clear after the commit marker: a scrubber sweep
+                # racing the manifest hash above sees old manifest +
+                # old metadata.json + new payload on a RE-commit and
+                # quarantines the dir (see _commit_tier_io)
+                clear_integrity_sidecars(save_name)
                 self._maybe_corrupt(save_name, step)
+                self._maybe_flip(save_name, step)
         if obs is not None:
             obs.registry.counter("checkpoint.saves").add()
             obs.registry.hist("checkpoint.save_s").record(
@@ -620,6 +679,66 @@ class Checkpointer:
             f.truncate(size // 2)
         print(f"ckpt_corrupt fault: truncated {victim} ({size} -> {size // 2})")
 
+    @staticmethod
+    def _maybe_flip(save_name, step, **ctx):
+        """``ckpt_shard_corrupt`` fault site: flip bytes mid-file inside
+        a manifest-recorded shard of the just-committed checkpoint
+        WITHOUT changing its size — the silent bit-rot/SDC storage class
+        that passes every size check and only full-content checksums
+        (manifest v2) or the scrubber catch. ``file=<substring>``
+        selects the victim among the manifest's recorded files (largest
+        match first, so the default hits an array shard, not an index
+        blob); ``bytes=N`` flips N bytes (default 4) at the file's
+        midpoint."""
+        from fms_fsdp_tpu.resilience.faults import fire_fault
+        from fms_fsdp_tpu.resilience.integrity import MANIFEST_NAME
+
+        params = fire_fault("ckpt_shard_corrupt", step=step, **ctx)
+        if params is None:
+            return
+        want = str(params.get("file", ""))
+        try:
+            with open(os.path.join(save_name, MANIFEST_NAME)) as f:
+                recorded = json.load(f).get("files", {})
+        except (OSError, ValueError):
+            recorded = {}
+        victims = sorted(
+            (
+                (int(size), rel)
+                for rel, size in recorded.items()
+                if want in rel and int(size) > 0
+            ),
+            key=lambda t: (-t[0], t[1]),
+        )
+        assert victims, (
+            f"ckpt_shard_corrupt: no recorded file matching {want!r} in "
+            f"{save_name}"
+        )
+        size, rel = victims[0]
+        victim = os.path.join(save_name, rel)
+        n = max(1, int(params.get("bytes", 4)))
+        off = size // 2
+        with open(victim, "rb+") as f:
+            f.seek(off)
+            data = f.read(min(n, size - off))
+            f.seek(off)
+            f.write(bytes(b ^ 0xFF for b in data))
+        # injection hygiene: a scrubber sweep racing the commit could
+        # have stamped a verified verdict in the instant before the
+        # flip — real bit-rot cannot consult the scrubber's clock, but
+        # the INJECTED corruption must be deterministic for the chaos
+        # soak, so the verdict for THIS dir (sidecars + memo entry) is
+        # invalidated with it. Scoped, not reset_cache(): the global
+        # reset would zero the monotone scrub_verified counter mid-run
+        # and force every other dir to re-hash.
+        from fms_fsdp_tpu.resilience.scrub import clear_integrity_sidecars
+
+        clear_integrity_sidecars(save_name)
+        print(
+            f"ckpt_shard_corrupt fault: flipped {len(data)} byte(s) at "
+            f"offset {off} of {victim} (size {size} unchanged)"
+        )
+
     # -- load ---------------------------------------------------------------
 
     def load(
@@ -651,7 +770,27 @@ class Checkpointer:
         with a warning instead of killing the restart. Only when every
         candidate fails does load raise (restarting a long run from
         scratch silently would be worse than crashing)."""
-        from fms_fsdp_tpu.resilience.integrity import verify_manifest
+        from fms_fsdp_tpu.resilience.scrub import (
+            cached_verify,
+            verified_resume_active,
+        )
+
+        # verified-resume policy (resilience/scrub.py): after a
+        # state-divergence relaunch the supervisor exports
+        # FMS_VERIFIED_RESUME — the newest checkpoint may hold the
+        # diverged replica's poison, so the restore must come from a
+        # checkpoint whose CONTENT has been verified (cached scrub
+        # verdict or a fresh full verify in this walk), even when
+        # checkpoint_verify was turned off
+        verified_resume = verified_resume_active()
+        verify = self.verify or verified_resume
+        if verified_resume and self.rank == 0:
+            self.report(
+                "Verified-resume policy active (FMS_VERIFIED_RESUME): "
+                "restoring only from scrub-verified checkpoints; the "
+                "newest unverified candidate is verified in place "
+                "before it may be restored."
+            )
 
         if candidates is None:
             is_resuming = False
@@ -678,6 +817,19 @@ class Checkpointer:
             self.report(
                 f"No valid checkpoint detected at {path}, starting from scratch."
             )
+            if dataloader is not None and getattr(
+                dataloader, "supports_fresh_start", False
+            ):
+                # from-scratch is a RESOLVED verdict, not an absence of
+                # one: tell the dataset (empty-path marker) so its
+                # setup() auto-load cannot resume the walk from a stale
+                # loader auto-save left by a torn or quarantined
+                # checkpoint this scan just rejected (model@0 +
+                # loader@N splits the stream; chaos_soak pins this).
+                # Gated on the advertised contract: a bare loader
+                # without the flag treats load_from_path("") as a real
+                # (missing) checkpoint path and must stay untouched.
+                dataloader.load_from_path("")
             return state, dataloader, 0, 0, False
 
         last_err = None
@@ -722,10 +874,27 @@ class Checkpointer:
                     "from scratch.",
                     model_load_time=time.time() - t0,
                 )
+                if dataloader is not None and getattr(
+                    dataloader, "supports_fresh_start", False
+                ):
+                    # same fresh-start marker as the no-candidates path:
+                    # "dataloader from scratch" must also suppress the
+                    # dataset's own stale-auto-save detection
+                    dataloader.load_from_path("")
                 return state, dataloader, 0, 0, is_resuming
 
-            if self.verify:
-                ok, problems = verify_manifest(load_path)
+            if verify:
+                # verdict-cached (resilience/scrub.py): a scrub-verified
+                # dir costs a digest read, a fresh verify is memoized
+                # (the topology scan already paid for this candidate),
+                # and rank 0 persists the outcome — success as a verdict
+                # sidecar, failure as a quarantine marker with the one
+                # actionable line, so no later walk re-hashes this dir
+                ok, problems = cached_verify(
+                    load_path,
+                    write_sidecars=(self.rank == 0),
+                    report=self.report,
+                )
                 # collective verdict: the restore below is a collective
                 # op, so a candidate one process rejects must be rejected
                 # by ALL of them (shared storage normally agrees; a
@@ -742,8 +911,24 @@ class Checkpointer:
                         f"integrity verification failed: {problems}"
                     )
                     continue
-                if problems:  # legacy pre-manifest checkpoint
-                    self.report(f"Note: {problems[0]}")
+                if problems:  # coverage note: legacy / size-only large files
+                    if verified_resume:
+                        # the policy demanded content verification; this
+                        # candidate can only offer partial coverage.
+                        # Restore it anyway (refusing every size-only
+                        # candidate would turn a divergence relaunch
+                        # into a crash loop on runs that disabled full
+                        # checksums) but say so loudly — it does NOT
+                        # count as scrub-verified (resilience/scrub.py)
+                        self.report(
+                            f"WARNING: verified-resume policy active but "
+                            f"{load_path} is only partially "
+                            f"content-verifiable ({problems[0]}); "
+                            f"restoring it anyway — enable "
+                            f"ckpt_full_checksums to close this gap."
+                        )
+                    else:
+                        self.report(f"Note: {problems[0]}")
 
             # metadata is read BEFORE the collective restore: a torn
             # metadata.json is a corrupt checkpoint (fall back while

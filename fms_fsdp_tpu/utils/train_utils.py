@@ -393,8 +393,12 @@ def _train_loop(
     dataloader=None,
 ):
     from fms_fsdp_tpu.parallel.mesh import process_slice_context
+    from fms_fsdp_tpu.resilience import divergence as _divergence
+    from fms_fsdp_tpu.resilience import scrub as _scrub
+    from fms_fsdp_tpu.resilience.divergence import StateDivergenceError
     from fms_fsdp_tpu.resilience.faults import fire_fault
     from fms_fsdp_tpu.resilience.guards import AnomalyGuard, StepWatchdog
+    from fms_fsdp_tpu.resilience.integrity import drain_integrity_events
     from fms_fsdp_tpu.resilience.slices import (
         SliceHealthMonitor,
         SliceLostError,
@@ -447,6 +451,43 @@ def _train_loop(
     train_loader = observer.wrap_data_iter(train_loader)
     step_fn = wrap_step_fn(step_fn, observer.timer)
     checkpointer.observer = observer
+
+    # state-integrity layer (docs/checkpointing.md "State integrity"):
+    # the background scrubber re-verifies committed checkpoints across
+    # all tiers at scrub_interval_steps cadence (rank 0 — sidecars on
+    # shared storage need a single writer), and the cross-replica
+    # divergence compare runs at report boundaries every
+    # divergence_check_interval steps on multi-process worlds
+    scrubber = None
+    scrub_interval = int(getattr(cfg, "scrub_interval_steps", 0) or 0)
+    if scrub_interval > 0 and rank == 0:
+        roots = _scrub.scrub_roots(checkpointer)
+        if roots:
+            scrubber = _scrub.CheckpointScrubber(roots, scrub_interval)
+    divergence_interval = int(
+        getattr(cfg, "divergence_check_interval", 0) or 0
+    )
+    if jax.process_count() == 1:
+        divergence_interval = 0  # nothing to compare against
+    last_divergence_check = start_step
+
+    def _integrity_stats():
+        # drained at report cadence on the main thread: the scrubber
+        # thread and every verify buffered into integrity's event
+        # window; detections become registry counters so they land in
+        # this record's extras (obs schema v8)
+        ev = drain_integrity_events()
+        if ev.get("shard_corrupt_detected"):
+            observer.registry.counter(
+                "integrity.shard_corrupt_detected"
+            ).add(int(ev["shard_corrupt_detected"]))
+        return {
+            "verify_s": float(ev.get("verify_s", 0.0)),
+            "scrub_verified": _scrub.total_verified(),
+            "divergence_checks": _divergence.total_checks(),
+        }
+
+    observer.attach_integrity_stats(_integrity_stats)
 
     def global_tokens(step):
         """Tokens seen through ``step``, exact at any step — checkpoint
@@ -613,6 +654,21 @@ def _train_loop(
             )
             if stall is not None:
                 time.sleep(float(stall.get("seconds", 3600)))
+            sdc = fire_fault("sdc_grad_flip", step=batch_idx, proc=rank)
+            if sdc is not None:
+                # injected silent data corruption: perturb THIS
+                # process's replica of one param leaf, host-side (zero
+                # compiled-program changes — see divergence.inject_sdc).
+                # Nothing here reports it: the cross-replica fingerprint
+                # compare at the next report boundary must DISCOVER it.
+                state, leaf_key = _divergence.inject_sdc(
+                    state, float(sdc.get("scale", 1.5))
+                )
+                print(
+                    f"sdc_grad_flip fault: scaled local shards of "
+                    f"{leaf_key} by {float(sdc.get('scale', 1.5))} on "
+                    f"proc {rank} at step {batch_idx}"
+                )
             state, metrics = step_fn(state, batch)
             window.append(metrics)
 
@@ -620,7 +676,45 @@ def _train_loop(
                 profiler.step()
 
             if batch_idx % cfg.report_interval == 0:
+                if _divergence.divergence_due(
+                    batch_idx, last_divergence_check, divergence_interval
+                ):
+                    # cross-replica fingerprint compare (one tiny
+                    # allgather, every rank at the same boundary),
+                    # BEFORE the window flush: loss/gnorm are the LAST
+                    # flushed window's post-reduce scalars — replicated
+                    # values that must be bit-identical on every
+                    # process — and the whole-state checksum proves the
+                    # dcn-replicated LIVE state still agrees.
+                    # Disagreement raises StateDivergenceError ->
+                    # classified state_divergence exit; the supervisor
+                    # relaunches under the verified-resume rule. No
+                    # checkpoint is saved on this path: the live state
+                    # is suspect.
+                    last_divergence_check = batch_idx
+                    try:
+                        _divergence.check_divergence(
+                            state,
+                            train_loss,
+                            g_norm,
+                            batch_idx,
+                            cfg,
+                            observer.registry,
+                        )
+                    except StateDivergenceError:
+                        # the pending window (and with it the
+                        # integrity.divergence_detected counter the
+                        # check just bumped) must reach one final
+                        # record before the classified abort — the
+                        # exit path never reports again
+                        flush_window(batch_idx, drain=True)
+                        raise
                 flush_window(batch_idx)
+
+                if scrubber is not None:
+                    # cadence check only; the sweep itself runs on a
+                    # daemon thread and self-throttles to one in flight
+                    scrubber.maybe_scrub(batch_idx)
 
                 if guard.should_abort():
                     # a poisoned data region or true divergence: skipping
@@ -704,8 +798,13 @@ def _train_loop(
         # domain" — instead of the raw transport traceback. Unrelated
         # failures (no slice went silent) re-raise untouched, and the
         # loop's own deliberate aborts skip the wait entirely (a
-        # whole-world abort must not be re-badged as a slice loss).
-        if monitor is not None and not isinstance(e, DeliberateAbort):
+        # whole-world abort must not be re-badged as a slice loss) —
+        # as does a divergence detection, which every rank raises from
+        # the same collective compare (a whole-world classified abort,
+        # not a dead fault domain).
+        if monitor is not None and not isinstance(
+            e, (DeliberateAbort, StateDivergenceError)
+        ):
             dead = monitor.wait_classify()
             if dead is not None:
                 # typed (resilience/slices.py) so the entry points'
@@ -719,5 +818,7 @@ def _train_loop(
             watchdog.stop()
         if monitor:
             monitor.stop()
+        if scrubber is not None:
+            scrubber.stop()
 
     return train_loss

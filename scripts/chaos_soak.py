@@ -48,6 +48,27 @@ through elastic resume, so every redone step is bit-identical):
                           code before anything commits; the relaunch
                           finds the corpus healed (the fault arms per
                           incarnation) so end-state bit-identity holds
+- ``ckpt_shard_corrupt``  (always scheduled, paired with a slice_kill
+                          two steps later) silent bit-rot: bytes flipped
+                          mid-shard in a COMMITTED checkpoint, size
+                          unchanged — a size-only check restores it
+                          blind. The next resume's full-content verify
+                          (manifest v2) must detect it, quarantine the
+                          step dir with one actionable line naming the
+                          bad shard, and fall back to the previous
+                          commit — which replays bit-identically, so
+                          end-state identity still holds
+- ``sdc_grad_flip``       (always scheduled) silent data corruption:
+                          one process's gradient scaled on a chosen
+                          step, diverging its slice's replicated state.
+                          Placed at commit+1 so the report-cadence
+                          divergence compare (divergence_check_interval
+                          = report cadence here) catches it at commit+2
+                          — BEFORE the poisoned update can ever commit
+                          — and exits classified ``state_divergence``;
+                          the supervisor relaunches under the
+                          verified-resume rule and the redone steps are
+                          bit-identical
 - ``ckpt_precommit_kill`` death between snapshot and commit marker
 - ``dcn_reduce_stall``    a parked rank; the step watchdog converts the
                           hang into a classified exit
@@ -67,7 +88,7 @@ incarnation to train on the same topology. The shrink policy
 identity is asserted at the restore boundary exactly as the elastic
 e2e does.
 
-CI smoke: ``python scripts/chaos_soak.py --seed 0 --budget-steps 24``
+CI smoke: ``python scripts/chaos_soak.py --seed 0 --budget-steps 32``
 (docs/resilience.md "Self-healing supervisor").
 """
 
@@ -149,16 +170,20 @@ def _corpus_of(marker):
 
 
 def sample_schedule(seed: int, budget: int, ckpt_interval: int, n_sites: int):
-    """The seeded fault schedule: one fault spec per incarnation,
-    ``slice_kill`` always first (the world is still 2-slice and the
+    """The seeded fault schedule: one fault spec per incarnation.
+    ``slice_kill`` is always first (the world is still 2-slice and the
     whole-domain loss is the acceptance criterion), ``corpus_kill``
-    always second (the data-layer fault domain), the rest drawn from the
-    registry pool at ascending steps so each fault fires after the
+    second (the data-layer fault domain), ``ckpt_shard_corrupt`` and
+    ``sdc_grad_flip`` always join (the silent-corruption classes the
+    state-integrity layer exists for), and the rest are drawn from the
+    registry pool — all at ascending steps so each fault fires after the
     previous incarnation's resume point."""
     rng = random.Random(seed)
     pool = ["ckpt_precommit_kill", "dcn_reduce_stall", "loader_worker"]
     rng.shuffle(pool)
-    sites = ["slice_kill", "corpus_kill"] + pool[: max(0, n_sites - 2)]
+    always = ["slice_kill", "corpus_kill", "ckpt_shard_corrupt",
+              "sdc_grad_flip"]
+    sites = always + pool[: max(0, n_sites - len(always))]
     # ascending fire positions, >= one commit apart so every resume
     # point (a committed multiple of ckpt_interval) precedes the next
     # fault; jitter keeps the schedule seed-dependent. (corpus_kill
@@ -169,6 +194,15 @@ def sample_schedule(seed: int, budget: int, ckpt_interval: int, n_sites: int):
     for _ in sites:
         positions.append(min(pos + rng.randrange(0, 2), budget - 2))
         pos = positions[-1] + ckpt_interval + 2
+    # shared headroom cap for the commit-aligned corruption sites,
+    # rounded DOWN to the commit cadence: they only fire at save steps,
+    # so an unaligned cap (budget not a multiple of the interval) would
+    # name a step that never saves and the fault would never fire. Two
+    # intervals of headroom: the poisoned/poison-free redo needs a
+    # commit after the fire step, before the budget.
+    corrupt_cap = (
+        (budget - 2 * ckpt_interval) // ckpt_interval
+    ) * ckpt_interval
     schedule = []
     for site, p in zip(sites, positions):
         if site == "slice_kill":
@@ -177,6 +211,31 @@ def sample_schedule(seed: int, budget: int, ckpt_interval: int, n_sites: int):
             # substring filter: every corpus matches, so the cascade
             # (degrade -> renormalize -> floor breach) is deterministic
             spec = "corpus_kill:corpus=dataset_"
+        elif site == "ckpt_shard_corrupt":
+            # flip bytes in the commit at the next cadence point, then
+            # kill a slice two steps later: the relaunch's resume finds
+            # the poisoned checkpoint newest, must detect + quarantine
+            # it, and fall back one commit (bit-identical redo)
+            at = min(
+                ((p + ckpt_interval - 1) // ckpt_interval) * ckpt_interval,
+                corrupt_cap,
+            )
+            spec = (
+                f"ckpt_shard_corrupt:step={at};"
+                f"slice_kill:slice=1:step={at + 2}"
+            )
+        elif site == "sdc_grad_flip":
+            # perturb proc 1's gradient at commit+1: the divergence
+            # compare at the next report boundary (commit+2) fires
+            # BEFORE the next commit (commit+interval), so the poisoned
+            # update never lands in a checkpoint and bit-identity holds
+            base = min(
+                ((p + ckpt_interval - 1) // ckpt_interval) * ckpt_interval,
+                # base must be a commit step for the commit+1 placement
+                # to hold — same shared cap as ckpt_shard_corrupt
+                corrupt_cap,
+            )
+            spec = f"sdc_grad_flip:step={base + 1}:proc=1"
         elif site == "ckpt_precommit_kill":
             # must land on the commit cadence to fire
             at = min(((p + ckpt_interval - 1) // ckpt_interval)
@@ -215,6 +274,14 @@ def child_specs(ckpt, data, walk, obs_dir, hb_dir, phase, num_steps,
         f"datasets={','.join(CORPORA)}",
         f"weights={MIX_WEIGHTS}",
         f"min_live_corpora={MIN_LIVE_CORPORA}",
+        # state-integrity layer armed (docs/checkpointing.md "State
+        # integrity"): cross-replica fingerprint compare at every
+        # report boundary (catches sdc_grad_flip before the next
+        # commit) and the background scrubber on the commit cadence
+        # (re-verifies committed checkpoints; verdicts cached by
+        # manifest digest)
+        "divergence_check_interval=2",
+        "scrub_interval_steps=4",
     ]
     specs = []
     for pid in range(2):
@@ -288,7 +355,7 @@ def _fired_faults(entries):
     child exited with a registry code (the os._exit / classified-exit
     paths), which environment failures (SIGABRT, generic tracebacks)
     never produce."""
-    registry = {2, 3, 4, 5, 7, 8}
+    registry = {2, 3, 4, 5, 7, 8, 9}
     return sum(
         1
         for e in entries
@@ -393,6 +460,34 @@ def run_soak(args, workdir):
                 e.get("classification") == "corpus_loss"
                 for e in res.ledger["entries"]
             ), f"no corpus_loss classification in {res.ledger}"
+            # ckpt_shard_corrupt contract: the size-preserving flip in a
+            # COMMITTED shard was detected by the full-content verify
+            # (counter + one actionable quarantine line naming the bad
+            # shard) and the resume routed around the poisoned step dir
+            assert "ckpt_shard_corrupt fault: flipped" in logs_text, (
+                "ckpt_shard_corrupt never fired"
+            )
+            assert "quarantined:" in logs_text and (
+                "checksum mismatch" in logs_text
+            ), (
+                "injected shard corruption was never detected/"
+                "quarantined: no integrity line in any attempt log"
+            )
+            # sdc_grad_flip contract: the cross-replica fingerprint
+            # compare detected the diverged replica (counter + line),
+            # the exit classified state_divergence, and every later
+            # incarnation resumed under the verified-resume rule
+            assert "state divergence detected" in logs_text, (
+                "sdc_grad_flip never tripped the divergence compare"
+            )
+            assert any(
+                e.get("classification") == "state_divergence"
+                for e in res.ledger["entries"]
+            ), f"no state_divergence classification in {res.ledger}"
+            assert "Verified-resume policy active" in logs_text, (
+                "the state_divergence relaunch never applied the "
+                "verified-resume rule"
+            )
 
         # committed windows per incarnation: attempt k resumed at the
         # START_STEP its log printed; its committed prefix ends where
@@ -438,6 +533,14 @@ def run_soak(args, workdir):
         )
         rec = last_metrics_record(obs)
         assert rec is not None, f"{kind}: no metrics.jsonl record"
+        # obs schema v8: the state-integrity layer was armed and worked
+        # — checkpoints scrub-verified, divergence compares performed
+        assert (rec.get("scrub_verified") or 0) >= 1, (
+            f"{kind}: scrubber never verified a checkpoint ({rec})"
+        )
+        assert (rec.get("divergence_checks") or 0) >= 1, (
+            f"{kind}: no divergence checks recorded ({rec})"
+        )
         # run-level goodput: committed work over the run's wall clock,
         # restart downtime included. (Per-incarnation window goodput
         # counts each incarnation's recompile as compute, so at CPU-test
@@ -540,16 +643,47 @@ def run_soak(args, workdir):
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--budget-steps", type=int, default=24)
+    ap.add_argument("--budget-steps", type=int, default=32)
     ap.add_argument("--ckpt-interval", type=int, default=4)
-    ap.add_argument("--sites", type=int, default=3,
-                    help="distinct fault sites to schedule (>=2; "
-                    "slice_kill and corpus_kill always included)")
+    ap.add_argument("--sites", type=int, default=5,
+                    help="distinct fault sites to schedule (>=4; "
+                    "slice_kill, corpus_kill, ckpt_shard_corrupt and "
+                    "sdc_grad_flip always included)")
     ap.add_argument("--backoff-s", type=float, default=0.2)
     ap.add_argument("--workdir", default=None,
                     help="working directory (kept); default: a temp dir, "
                     "removed on success")
     args = ap.parse_args(argv)
+    # fail fast on budgets the schedule cannot place: simulate it and
+    # require the two commit-aligned corruption sites to land on
+    # DISTINCT commit steps with a prior commit to fall back to. A
+    # shared headroom cap squashes both onto the same step for small
+    # budgets (flip, sdc perturbation, and the paired slice_kill then
+    # stack into one incarnation), and a cap <= 0 names a step that
+    # never saves — either way the soak would die minutes later on a
+    # misleading "never fired"/identity assertion instead of here.
+    fires = {}
+    for site, spec in sample_schedule(
+        args.seed, args.budget_steps, args.ckpt_interval, args.sites
+    ):
+        if site == "ckpt_shard_corrupt":
+            fires[site] = int(spec.split("step=", 1)[1].split(";", 1)[0])
+        elif site == "sdc_grad_flip":
+            # fires at commit+1: the commit step is what must be distinct
+            fires[site] = (
+                int(spec.split("step=", 1)[1].split(":", 1)[0]) - 1
+            )
+    if (
+        any(at < args.ckpt_interval for at in fires.values())
+        or len(set(fires.values())) < len(fires)
+    ):
+        ap.error(
+            f"--budget-steps {args.budget_steps} is too small for the "
+            f"corruption sites at --ckpt-interval {args.ckpt_interval}: "
+            f"their commit-aligned fire steps resolve to {fires} — they "
+            "need distinct commit steps, each with an earlier commit to "
+            "fall back to (CI runs 32)"
+        )
 
     keep = args.workdir is not None
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_soak_")

@@ -1,0 +1,109 @@
+"""Fleet checkpoint scrubber CLI: full-content re-verification of every
+committed checkpoint under one or more checkpoint roots, with quarantine
+of corrupt step dirs and digest-cached verdicts.
+
+The in-run scrubber (``scrub_interval_steps``, resilience/scrub.py)
+covers live training; this CLI is the fleet/cron form of the same pass —
+point it at the checkpoints/ folders of the runs you care about (both
+tiers) and it:
+
+- verifies every committed ``step_N_ckp`` against its manifest,
+  including the version-2 chunked content checksums for large shards;
+- **quarantines** a failing dir (``integrity_quarantine.json`` sidecar
+  + one actionable line naming the bad shard/chunk) so every resume and
+  fallback walk skips it before a crash needs it;
+- **caches** passing verdicts by manifest digest
+  (``integrity_scrub.json``), so the next sweep — or the next restore —
+  re-hashes nothing that hasn't changed;
+- exits nonzero when anything is (or already was) quarantined, so a
+  cron wrapper can page.
+
+Examples::
+
+    python scripts/scrub_checkpoints.py /data/run1/ckpt/checkpoints \\
+        /local/run1/ckpt/checkpoints
+    python scripts/scrub_checkpoints.py --release /data/.../step_80_ckp
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "roots",
+        nargs="*",
+        help="checkpoint roots (the checkpoints/ folders; every "
+        "committed step_N_ckp under each is scrubbed)",
+    )
+    ap.add_argument(
+        "--release",
+        action="append",
+        default=[],
+        metavar="STEP_DIR",
+        help="remove the quarantine marker from a step dir (after "
+        "repair, or to deliberately accept it); may repeat",
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="emit a machine-readable summary line on stdout",
+    )
+    args = ap.parse_args(argv)
+    if not args.roots and not args.release:
+        ap.error("nothing to do: pass checkpoint roots and/or --release")
+
+    from fms_fsdp_tpu.resilience.scrub import (
+        committed_step_dirs,
+        is_quarantined,
+        quarantine_info,
+        release_quarantine,
+        scrub_checkpoint,
+    )
+
+    release_failed = False
+    for path in args.release:
+        if release_quarantine(path):
+            print(f"released quarantine on {path}")
+        elif is_quarantined(path):
+            # False + still quarantined = the marker removal itself
+            # failed (storage flake / read-only): the dir is NOT
+            # released and the operator must not read this as a typo
+            release_failed = True
+            print(
+                f"FAILED to remove the quarantine marker on {path} "
+                "(storage error?); the dir is still quarantined",
+                file=sys.stderr,
+            )
+        else:
+            print(f"no quarantine marker on {path} (nothing to release)")
+
+    summary = {"verified": 0, "quarantined": 0, "legacy": 0, "dirs": []}
+    for root in args.roots:
+        dirs = committed_step_dirs(root)
+        if not dirs:
+            print(f"{root}: no committed checkpoints")
+            continue
+        for ckpt_dir in dirs:
+            status, problems = scrub_checkpoint(ckpt_dir)
+            summary[status] += 1
+            summary["dirs"].append({"dir": ckpt_dir, "status": status})
+            if status == "quarantined":
+                info = quarantine_info(ckpt_dir) or {}
+                first = (problems or info.get("problems") or ["?"])[0]
+                print(f"QUARANTINED {ckpt_dir}: {first}")
+            else:
+                print(f"{status:10s} {ckpt_dir}")
+    if args.json:
+        print(json.dumps(summary))
+    return 1 if (summary["quarantined"] or release_failed) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

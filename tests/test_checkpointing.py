@@ -154,6 +154,141 @@ def test_single_file_load(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_cleanup_survives_scrubber_sidecar_race(tmp_path, monkeypatch):
+    """Retention GC racing the rank-0 scrubber thread: a sidecar stamped
+    into the oldest dir between rmtree's directory scan and its final
+    rmdir surfaces as OSError(ENOTEMPTY); _cleanup must clear the
+    sidecars and retry instead of crashing the save path."""
+    ck = Checkpointer(str(tmp_path), 1, "fsdp", rank=0)
+    for step in (2, 4, 6):
+        d = os.path.join(ck.ckp_path, f"step_{step}_ckp")
+        os.makedirs(d)
+        with open(os.path.join(d, "metadata.json"), "w") as f:
+            f.write("{}")
+
+    import shutil as _shutil
+
+    real = _shutil.rmtree
+    calls = {"n": 0}
+
+    def flaky(path, *a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError(39, "Directory not empty", str(path))
+        return real(path, *a, **k)
+
+    monkeypatch.setattr(
+        "fms_fsdp_tpu.utils.checkpointing.shutil.rmtree", flaky
+    )
+    ck._cleanup()  # must not raise
+    left = sorted(
+        x for x in os.listdir(ck.ckp_path) if x.startswith("step_")
+    )
+    assert left == ["step_6_ckp"]
+    assert calls["n"] >= 3  # failed attempt + retry + next victim
+
+
+class _RecordingLoader:
+    """Stands in for the train dataloader: records the paths the
+    Checkpointer resolves to it (incl. the empty fresh-start marker)."""
+
+    # the contract CheckpointDataset/StatefulDataLoader advertise; the
+    # marker is only sent to loaders that opted in
+    supports_fresh_start = True
+
+    def __init__(self):
+        self.loaded = []
+
+    def load_from_path(self, path):
+        self.loaded.append(path)
+
+
+def test_from_scratch_marks_loader_fresh_start(tmp_path):
+    """When load resolves no candidate, the dataloader receives the
+    empty-path fresh-start marker so its setup() auto-detect cannot
+    resume the walk from a stale loader auto-save (the model@0 +
+    loader@N split chaos_soak.py flushed out)."""
+    cfg = _cfg(ckpt_save_path=str(tmp_path))
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    state, _ = _state(cfg, mesh)
+    ck = Checkpointer(str(tmp_path), 5, "fsdp", rank=0)
+    dl = _RecordingLoader()
+    _, _, step, _, resuming = ck.load(state, dl)
+    assert step == 0 and not resuming
+    assert dl.loaded == [""]
+
+
+def test_single_file_load_marks_loader_fresh_start(tmp_path):
+    """The single-file branch promises "dataloader from scratch" — it
+    must send the same marker instead of leaving the dataset free to
+    auto-detect a stale auto-save."""
+    cfg = _cfg(ckpt_save_path=str(tmp_path))
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    state, _ = _state(cfg, mesh)
+    params_np = jax.tree.map(np.asarray, state["params"])
+    fpath = tmp_path / "model_only.pkl"
+    with open(fpath, "wb") as f:
+        pickle.dump({"model_state": params_np}, f)
+    ck = Checkpointer(str(tmp_path / "fresh"), 5, "ddp", rank=0)
+    dl = _RecordingLoader()
+    _, _, step, _, _ = ck.load(state, dl, path=str(fpath))
+    assert step == 0
+    assert dl.loaded == [""]
+
+
+def test_bare_loader_without_contract_never_sent_marker(tmp_path):
+    """A loader that does not advertise ``supports_fresh_start`` treats
+    ``load_from_path("")`` as a real (missing) checkpoint path — the
+    from-scratch verdict must leave it untouched, exactly as before the
+    marker existed."""
+    cfg = _cfg(ckpt_save_path=str(tmp_path))
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    state, _ = _state(cfg, mesh)
+    ck = Checkpointer(str(tmp_path), 5, "fsdp", rank=0)
+
+    class _Bare:
+        def __init__(self):
+            self.loaded = []
+
+        def load_from_path(self, path):
+            self.loaded.append(path)
+
+    dl = _Bare()
+    _, _, step, _, resuming = ck.load(state, dl)
+    assert step == 0 and not resuming
+    assert dl.loaded == []
+
+
+def test_recommit_clears_race_stamped_quarantine(tmp_path, monkeypatch):
+    """A rank-0 scrubber sweep racing a RE-commit's manifest hash sees
+    old manifest + old metadata.json + new payload in the dir and
+    quarantines it; the commit must re-clear sidecars AFTER the marker
+    lands, or the freshly committed checkpoint is skipped by every
+    resume forever."""
+    from fms_fsdp_tpu.resilience import integrity, scrub
+
+    cfg = _cfg()
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    state, _ = _state(cfg, mesh)
+    ck = Checkpointer(str(tmp_path), 5, "fsdp", rank=0)
+
+    real_wm = integrity.write_manifest
+
+    def racing_wm(save_name, **kw):
+        out = real_wm(save_name, **kw)
+        # the racing sweep judged the in-flight window and quarantined
+        scrub.quarantine_checkpoint(
+            save_name, ["checksum mismatch state/x"], report=lambda m: None
+        )
+        return out
+
+    monkeypatch.setattr(integrity, "write_manifest", racing_wm)
+    ck.save(4, state, None, tokens_seen=1)
+    save_name = os.path.join(ck.ckp_path, "step_4_ckp")
+    assert os.path.isfile(os.path.join(save_name, "metadata.json"))
+    assert not scrub.is_quarantined(save_name)
+
+
 def test_external_load_restarts_schedule(tmp_path):
     """Loading an external checkpoint (not a job restart) keeps optimizer
     moments but zeroes the step counter so the LR schedule restarts

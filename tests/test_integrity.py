@@ -1,0 +1,1038 @@
+"""State-integrity layer tests (docs/checkpointing.md "State
+integrity"):
+
+- manifest v2: chunked full-content checksums for large array files —
+  a same-size bit-flip in a large shard that PASSES a size-only check
+  is caught, and the failing chunk is named;
+- verify satellites: unrecorded files are flagged (loader_state/commit
+  marker/sidecars stay exempt), a torn/invalid manifest.json is a
+  verification problem (never a raise), v1 manifests verify size-only
+  with a note;
+- scrubber: quarantine sidecar + actionable line, the fallback chain
+  skips quarantined dirs, verdicts are cached by manifest digest (no
+  double hashing), re-commits clear stale sidecars;
+- cross-replica divergence: fingerprint units, cadence gate, the
+  state_divergence exit class, the supervisor's verified-resume policy,
+  and the slow 2-process gloo e2e (agreement completes; a one-process
+  sdc_grad_flip is detected and classified);
+- fault sites ckpt_shard_corrupt (size-preserving flip, post-commit)
+  and sdc_grad_flip (trace-time per-process grad perturbation).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fms_fsdp_tpu.resilience import divergence as divergence_mod
+from fms_fsdp_tpu.resilience import integrity, scrub
+from fms_fsdp_tpu.resilience.divergence import (
+    StateDivergenceError,
+    check_divergence,
+    divergence_due,
+    params_checksum,
+    scalar_digest,
+)
+from fms_fsdp_tpu.resilience.exits import (
+    EXIT_CODES,
+    classify_exception,
+    classify_world,
+)
+from fms_fsdp_tpu.resilience.faults import configure_faults
+from fms_fsdp_tpu.resilience.integrity import (
+    CHECKSUM_MAX_BYTES,
+    drain_integrity_events,
+    verify_manifest,
+    write_manifest,
+)
+from fms_fsdp_tpu.resilience.scrub import (
+    CheckpointScrubber,
+    cached_verify,
+    clear_integrity_sidecars,
+    is_quarantined,
+    quarantine_checkpoint,
+    release_quarantine,
+    scrub_checkpoint,
+    scrub_pass,
+    scrub_verdict,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "_elastic_child.py")
+MARKER_BASE = 1024
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    """Every test starts with empty fault/verdict/event state and leaves
+    none behind."""
+    configure_faults("")
+    scrub.reset_cache()
+    divergence_mod.reset_checks()
+    drain_integrity_events()
+    yield
+    configure_faults("")
+    scrub.reset_cache()
+    divergence_mod.reset_checks()
+    drain_integrity_events()
+
+
+def _large_file_dir(tmp_path, large_bytes=CHECKSUM_MAX_BYTES + 4096):
+    """A checkpoint-shaped dir with one small and one LARGE file (above
+    the whole-file checksum cap — size-only under manifest v1)."""
+    d = tmp_path / "step_8_ckp"
+    os.makedirs(d / "state")
+    rng = np.random.default_rng(0)
+    (d / "state" / "shard_0.bin").write_bytes(
+        rng.integers(0, 256, large_bytes, np.uint8).tobytes()
+    )
+    (d / "state" / "index.json").write_text('{"a": 1}')
+    return d
+
+
+def _flip_byte(path, offset=None):
+    """Size-preserving corruption: invert one byte mid-file."""
+    size = os.path.getsize(path)
+    offset = size // 2 if offset is None else offset
+    with open(path, "rb+") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+    assert os.path.getsize(path) == size
+
+
+# ---- manifest v2 -----------------------------------------------------------
+
+
+def test_manifest_v2_roundtrip_and_chunk_records(tmp_path):
+    d = _large_file_dir(tmp_path)
+    write_manifest(str(d), chunk_bytes=1 << 18)
+    with open(d / "manifest.json") as f:
+        man = json.load(f)
+    assert man["version"] == 2
+    rec = man["chunks"]["state/shard_0.bin"]
+    assert rec["chunk_bytes"] == 1 << 18
+    # ceil((1MiB + 4096) / 256KiB) = 5 chunks
+    assert len(rec["digests"]) == 5
+    # small files keep whole-file checksums, not chunk records
+    assert "state/index.json" in man["checksums"]
+    assert "state/index.json" not in man["chunks"]
+    ok, problems = verify_manifest(str(d))
+    assert ok and not problems
+
+
+def test_chunked_checksum_catches_same_size_flip_in_large_shard(tmp_path):
+    """THE acceptance pin: a corrupted large shard that passes a
+    size-only check is caught by manifest v2, and the bad chunk is
+    named."""
+    d = _large_file_dir(tmp_path)
+    shard = d / "state" / "shard_0.bin"
+
+    # size-only coverage (v1 semantics / ckpt_full_checksums=False):
+    # the flip is INVISIBLE — this is the hole v2 closes
+    write_manifest(str(d), full_checksums=False)
+    _flip_byte(shard)
+    ok, problems = verify_manifest(str(d))
+    assert ok, problems
+    assert any("size only" in p for p in problems)  # the compat note
+
+    # full coverage: the same flip is a named chunk mismatch
+    write_manifest(str(d), chunk_bytes=1 << 18)
+    drain_integrity_events()
+    _flip_byte(shard)
+    ok, problems = verify_manifest(str(d))
+    assert not ok
+    [p] = [p for p in problems if "checksum mismatch" in p]
+    # the flip lands mid-file -> chunk 3 of 5, and the offset is stated
+    assert "state/shard_0.bin" in p and "chunk 3/5" in p, p
+    # the detection was accounted (obs v8 counter feed)
+    ev = drain_integrity_events()
+    assert ev["shard_corrupt_detected"] == 1
+    assert ev["verify_s"] > 0
+
+
+def test_v1_manifest_verifies_size_only_with_note(tmp_path):
+    """Version-1 manifests (pre-state-integrity checkpoints) keep
+    loading: large files verified by size only, stated in a note."""
+    d = _large_file_dir(tmp_path)
+    files, checksums = {}, {}
+    for root, _, names in os.walk(d):
+        for name in names:
+            full = os.path.join(root, name)
+            rel = os.path.relpath(full, d)
+            files[rel] = os.path.getsize(full)
+            if files[rel] <= CHECKSUM_MAX_BYTES:
+                checksums[rel] = integrity._sha256(full)
+    with open(d / "manifest.json", "w") as f:
+        json.dump(
+            {"version": 1, "files": files, "checksums": checksums}, f
+        )
+    ok, problems = verify_manifest(str(d))
+    assert ok
+    assert any("version 1" in p and "size only" in p for p in problems)
+    # same-size corruption of the large shard: silently passes under v1
+    _flip_byte(d / "state" / "shard_0.bin")
+    ok, _ = verify_manifest(str(d))
+    assert ok
+    # but truncation is still caught
+    with open(d / "state" / "shard_0.bin", "rb+") as f:
+        f.truncate(100)
+    ok, problems = verify_manifest(str(d))
+    assert not ok and any("size mismatch" in p for p in problems)
+
+
+def test_unrecorded_file_flagged_exemptions_hold(tmp_path):
+    d = _large_file_dir(tmp_path)
+    write_manifest(str(d))
+    # post-commit writes that are legitimate stay exempt
+    (d / "metadata.json").write_text("{}")
+    (d / "loader_state_3.pkl").write_bytes(b"x" * 64)
+    (d / scrub.VERDICT_NAME).write_text("{}")
+    ok, problems = verify_manifest(str(d))
+    assert ok and not problems, problems
+    # a foreign stray is a problem
+    (d / "state" / "stray.partial").write_bytes(b"y" * 128)
+    ok, problems = verify_manifest(str(d))
+    assert not ok
+    assert any(
+        "unrecorded file" in p and "stray.partial" in p for p in problems
+    ), problems
+
+
+def test_torn_manifest_is_problem_not_raise(tmp_path):
+    d = tmp_path / "step_2_ckp"
+    os.makedirs(d)
+    # truncated to invalid JSON
+    (d / "manifest.json").write_text('{"version": 2, "files": {')
+    ok, problems = verify_manifest(str(d))
+    assert not ok and any("malformed" in p or "unreadable" in p
+                          for p in problems)
+    # valid JSON, wrong shape (a bare list)
+    (d / "manifest.json").write_text("[1, 2, 3]")
+    ok, problems = verify_manifest(str(d))
+    assert not ok
+    # valid dict, files is a list -> int()/items() paths must not raise
+    (d / "manifest.json").write_text('{"version": 2, "files": [1]}')
+    ok, problems = verify_manifest(str(d))
+    assert not ok
+
+
+# ---- scrubber --------------------------------------------------------------
+
+
+def _committed_dir(tmp_path, step, large=False):
+    d = tmp_path / "checkpoints" / f"step_{step}_ckp"
+    os.makedirs(d / "state", exist_ok=True)
+    size = (CHECKSUM_MAX_BYTES + 4096) if large else 4096
+    rng = np.random.default_rng(step)
+    (d / "state" / "data.bin").write_bytes(
+        rng.integers(0, 256, size, np.uint8).tobytes()
+    )
+    write_manifest(str(d), chunk_bytes=1 << 18)
+    (d / "metadata.json").write_text(json.dumps({"step": step}))
+    return d
+
+
+def test_scrub_quarantines_corrupt_dir_with_actionable_line(tmp_path):
+    good = _committed_dir(tmp_path, 4)
+    bad = _committed_dir(tmp_path, 8, large=True)
+    _flip_byte(bad / "state" / "data.bin")
+    lines = []
+    counts = scrub_pass([str(tmp_path / "checkpoints")], report=lines.append)
+    assert counts == {"verified": 1, "quarantined": 1, "legacy": 0}
+    assert is_quarantined(str(bad)) and not is_quarantined(str(good))
+    [line] = lines
+    # ONE actionable line, naming the bad shard
+    assert "quarantined" in line and "state/data.bin" in line, line
+    # verdict sidecar on the good dir, quarantine marker on the bad one
+    assert scrub_verdict(str(good)) == "verified"
+    assert scrub_verdict(str(bad)) == "quarantined"
+    # a later scrub is stable and re-hashes nothing
+    counts = scrub_pass([str(tmp_path / "checkpoints")])
+    assert counts == {"verified": 1, "quarantined": 1, "legacy": 0}
+
+
+def test_cached_verdict_skips_rehash_but_still_catches_truncation(
+    tmp_path, monkeypatch
+):
+    d = _committed_dir(tmp_path, 4, large=True)
+    status, _ = scrub_checkpoint(str(d))
+    assert status == "verified"
+    scrub.reset_cache()  # fresh process: only the sidecar remains
+
+    calls = {"n": 0}
+    real_chunks = integrity._chunk_digests
+    real_sha = integrity._sha256
+
+    def counting_chunks(path, chunk_bytes):
+        calls["n"] += 1
+        return real_chunks(path, chunk_bytes)
+
+    def counting_sha(path):
+        calls["n"] += 1
+        return real_sha(path)
+
+    monkeypatch.setattr(integrity, "_chunk_digests", counting_chunks)
+    monkeypatch.setattr(integrity, "_sha256", counting_sha)
+    # verdict matches the manifest digest: the walk never re-hashes
+    ok, problems = cached_verify(str(d))
+    assert ok and not problems
+    assert calls["n"] == 0, "cached verdict must not re-hash content"
+    # but the cheap half still runs: truncation after the scrub is seen
+    with open(d / "state" / "data.bin", "rb+") as f:
+        f.truncate(64)
+    ok, problems = cached_verify(str(d))
+    assert not ok and any("size mismatch" in p for p in problems)
+    assert calls["n"] == 0  # caught without hashing
+
+
+def test_memo_hit_still_persists_sidecars(tmp_path, monkeypatch):
+    """The production entry order is resume_topology() (no sidecar
+    writes) THEN load() (rank 0 writes sidecars): the second call hits
+    the in-process memo and must still persist the outcome — a corrupt
+    newest checkpoint detected at scan time would otherwise stay
+    detected-but-never-quarantined (re-hashed by every later
+    incarnation), and a verified one would never get its verdict."""
+    good = _committed_dir(tmp_path, 4)
+    bad = _committed_dir(tmp_path, 8, large=True)
+    _flip_byte(bad / "state" / "data.bin")
+
+    # the topology-scan pass: verifies, memoizes, writes nothing
+    ok, _ = cached_verify(str(good))
+    assert ok
+    ok, _ = cached_verify(str(bad))
+    assert not ok
+    assert not is_quarantined(str(bad))
+    assert scrub_verdict(str(good)) == "unknown"
+
+    # the load pass: memo hits, but sidecars land — and no re-hash
+    calls = {"n": 0}
+    real_chunks, real_sha = integrity._chunk_digests, integrity._sha256
+    monkeypatch.setattr(
+        integrity, "_chunk_digests",
+        lambda p, c: calls.__setitem__("n", calls["n"] + 1)
+        or real_chunks(p, c),
+    )
+    monkeypatch.setattr(
+        integrity, "_sha256",
+        lambda p: calls.__setitem__("n", calls["n"] + 1) or real_sha(p),
+    )
+    lines = []
+    ok, _ = cached_verify(str(good), write_sidecars=True,
+                          report=lines.append)
+    assert ok and scrub_verdict(str(good)) == "verified"
+    ok, problems = cached_verify(str(bad), write_sidecars=True,
+                                 report=lines.append)
+    assert not ok and is_quarantined(str(bad))
+    assert calls["n"] == 0, "memo hits must not re-hash content"
+    assert any("quarantined" in ln for ln in lines)
+    # and the walk now skips the bad dir outright
+    assert cached_verify(str(bad))[0] is False
+
+
+def test_scrub_verified_count_is_monotone(tmp_path):
+    """obs v8 ``scrub_verified`` is cumulative: a re-commit into an
+    existing step dir (clear_integrity_sidecars) drops the dir from the
+    verified SET but never decrements the count; re-verifying the fresh
+    bytes counts again."""
+    d = _committed_dir(tmp_path, 4)
+    assert scrub_checkpoint(str(d), report=lambda m: None)[0] == "verified"
+    assert scrub.total_verified() == 1
+    clear_integrity_sidecars(str(d))
+    assert scrub.total_verified() == 1  # history, not membership
+    write_manifest(str(d), chunk_bytes=1 << 18)  # re-commit
+    assert scrub_checkpoint(str(d), report=lambda m: None)[0] == "verified"
+    assert scrub.total_verified() == 2
+
+
+def test_size_only_pass_never_counts_as_scrub_verified(tmp_path):
+    """A passing verify whose large files are covered by size only (v1
+    manifest / ckpt_full_checksums=False) must not earn a verified
+    verdict sidecar, a scrub_verified count, or a "verified" CLI
+    status — or the verified-resume policy would silently degrade to
+    the trust-on-size restore it rules out."""
+    d = tmp_path / "checkpoints" / "step_4_ckp"
+    os.makedirs(d / "state", exist_ok=True)
+    rng = np.random.default_rng(0)
+    (d / "state" / "big.bin").write_bytes(
+        rng.integers(0, 256, CHECKSUM_MAX_BYTES + 4096, np.uint8).tobytes()
+    )
+    write_manifest(str(d), full_checksums=False)
+    (d / "metadata.json").write_text(json.dumps({"step": 4}))
+
+    scrub.reset_cache()
+    before = scrub.total_verified()
+    status, problems = scrub_checkpoint(str(d), report=lambda m: None)
+    assert status == "legacy" and any("size only" in p for p in problems)
+    assert scrub.total_verified() == before  # not content-verified
+    assert scrub_verdict(str(d)) == "unknown"  # no verdict sidecar
+    # load still accepts it (ok=True), notes intact on the memo hit too
+    ok, p1 = cached_verify(str(d))
+    ok2, p2 = cached_verify(str(d))
+    assert ok and ok2
+    assert any("size only" in p for p in p1)
+    assert any("size only" in p for p in p2)
+
+
+def test_release_quarantine_drops_stale_verdict(tmp_path):
+    """--release must drop BOTH sidecars: a verdict stamped before the
+    dir went bad still matches the manifest digest (the manifest bytes
+    never changed), so leaving it behind would read the released dir as
+    content-verified without anyone re-hashing the repaired bytes."""
+    d = _committed_dir(tmp_path, 4, large=True)
+    assert scrub_checkpoint(str(d), report=lambda m: None)[0] == "verified"
+    assert scrub_verdict(str(d)) == "verified"
+    # the dir goes bad after earning its verdict: the cheap size check
+    # quarantines it on the next walk (verdict sidecar left in place)
+    os.truncate(d / "state" / "data.bin", 100)
+    scrub.reset_cache()
+    ok, _ = cached_verify(str(d), write_sidecars=True, report=lambda m: None)
+    assert not ok and is_quarantined(str(d))
+    # operator repairs and releases: the dir must re-verify from scratch
+    assert release_quarantine(str(d))
+    assert not is_quarantined(str(d))
+    assert scrub_verdict(str(d)) == "unknown"  # stale verdict gone too
+
+
+def test_cli_release_not_reverted_by_live_memo(tmp_path):
+    """A CLI ``--release`` runs in ANOTHER process: it removes the
+    sidecars but cannot reach a live run's in-process memo, and
+    repairing the shard bytes does not change the manifest digest the
+    memo is keyed on. Once a failure is stamped as a quarantine sidecar,
+    the sidecar is the source of truth — the live run must re-verify the
+    repaired bytes instead of re-quarantining from its stale memo."""
+    d = _committed_dir(tmp_path, 4, large=True)
+    original = (d / "state" / "data.bin").read_bytes()
+    _flip_byte(d / "state" / "data.bin")
+    ok, _ = cached_verify(str(d), write_sidecars=True, report=lambda m: None)
+    assert not ok and is_quarantined(str(d))
+    # operator repairs the shard (manifest digest unchanged) and
+    # releases via the CLI in a different process: only the sidecars go
+    # — NOT release_quarantine(), which would also clear THIS process's
+    # memo, exactly what a separate CLI process cannot do
+    (d / "state" / "data.bin").write_bytes(original)
+    os.remove(d / scrub.QUARANTINE_NAME)
+    ok, problems = cached_verify(
+        str(d), write_sidecars=True, report=lambda m: None
+    )
+    assert ok and not problems, problems
+    assert not is_quarantined(str(d)), "stale memo reverted the release"
+    assert scrub_verdict(str(d)) == "verified"
+
+
+def test_positive_verdicts_expire_and_catch_post_verdict_rot(
+    tmp_path, monkeypatch
+):
+    """The digest key only changes when the dir is re-written: bit-rot
+    AFTER a successful scrub leaves the manifest (and digest) untouched,
+    so without a TTL the rot would hide behind the verdict forever —
+    including under verified-resume. An expired verdict (sidecar AND the
+    in-process memo) must force a full re-hash that catches the flip."""
+    d = _committed_dir(tmp_path, 4, large=True)
+
+    class _Clock:
+        now = 1_000_000.0
+
+        @classmethod
+        def time(cls):
+            return cls.now
+
+        @classmethod
+        def monotonic(cls):
+            return cls.now
+
+    monkeypatch.setattr(scrub, "time", _Clock)
+    monkeypatch.setenv(scrub.ENV_VERDICT_TTL, "1000")
+
+    assert scrub_checkpoint(str(d), report=lambda m: None)[0] == "verified"
+    # rot lands after the verdict: same size, manifest untouched
+    _flip_byte(d / "state" / "data.bin")
+    # within the TTL the cache masks it — the documented cache contract
+    _Clock.now += 600
+    ok, _ = cached_verify(str(d))
+    assert ok
+    # the cache hit must NOT have refreshed the stamp: a sweep cadence
+    # shorter than the TTL would otherwise keep the verdict alive
+    # forever. 1200s past the ORIGINAL verify (600s past the hit) the
+    # verdict is expired and the re-hash catches the flip.
+    _Clock.now += 600
+    assert scrub_verdict(str(d)) == "unknown"  # expired, not verified
+    ok, problems = cached_verify(
+        str(d), write_sidecars=True, report=lambda m: None
+    )
+    assert not ok and any("checksum mismatch" in p for p in problems)
+    assert is_quarantined(str(d))
+    # TTL=0 disables expiry entirely
+    monkeypatch.setenv(scrub.ENV_VERDICT_TTL, "0")
+    assert not scrub._verdict_expired(0.0)
+
+
+def test_memo_hit_persist_keeps_original_stamp(tmp_path, monkeypatch):
+    """The production entry order is scan (no sidecar writes) then walk
+    (rank 0 persists): the walk's memo-hit persist must stamp the
+    ORIGINAL hash time into the verdict sidecar, not now — a refreshed
+    stamp would restart the TTL clock without a byte re-read."""
+
+    class _Clock:
+        now = 1_000_000.0
+
+        @classmethod
+        def time(cls):
+            return cls.now
+
+        @classmethod
+        def monotonic(cls):
+            return cls.now
+
+    monkeypatch.setattr(scrub, "time", _Clock)
+    d = _committed_dir(tmp_path, 4)
+    ok, _ = cached_verify(str(d))  # the scan: hashes, memo only
+    assert ok
+    _Clock.now += 500
+    ok, _ = cached_verify(str(d), write_sidecars=True)  # walk: persists
+    assert ok
+    v = json.loads((d / scrub.VERDICT_NAME).read_text())
+    assert v["verified_unix"] == 1_000_000.0  # original hash time
+
+
+def test_release_on_healthy_dir_keeps_cached_verdict(tmp_path):
+    """``--release`` against a dir with NO quarantine marker (operator
+    typo'd the step dir) must be a true no-op: discarding a healthy
+    dir's verdict sidecar would cost a full re-hash on the next walk."""
+    d = _committed_dir(tmp_path, 4, large=True)
+    assert scrub_checkpoint(str(d), report=lambda m: None)[0] == "verified"
+    assert release_quarantine(str(d)) is False
+    assert scrub_verdict(str(d)) == "verified"  # verdict survived
+
+
+def test_failed_release_keeps_quarantine_state(tmp_path, monkeypatch):
+    """When the quarantine marker removal itself fails (storage flake /
+    read-only), the dir is still quarantined — release must report
+    failure having touched NOTHING, not half-release by discarding the
+    verdict sidecar first."""
+    d = _committed_dir(tmp_path, 4, large=True)
+    assert scrub_checkpoint(str(d), report=lambda m: None)[0] == "verified"
+    os.truncate(d / "state" / "data.bin", 100)
+    scrub.reset_cache()
+    ok, _ = cached_verify(str(d), write_sidecars=True, report=lambda m: None)
+    assert not ok and is_quarantined(str(d))
+    assert (d / scrub.VERDICT_NAME).exists()  # stale verdict in place
+
+    real_remove = os.remove
+
+    def deny_marker(path):
+        if str(path).endswith(scrub.QUARANTINE_NAME):
+            raise OSError("read-only storage")
+        real_remove(path)
+
+    monkeypatch.setattr(scrub.os, "remove", deny_marker)
+    assert release_quarantine(str(d)) is False
+    assert is_quarantined(str(d))  # still routed around
+    assert (d / scrub.VERDICT_NAME).exists()  # nothing discarded
+
+
+def test_soak_budget_guard_fails_fast():
+    """A budget whose commit-aligned corruption sites resolve to an
+    impossible or COLLIDING placement (a fire step that never saves, or
+    ckpt_shard_corrupt and sdc_grad_flip squashed onto the same commit
+    step — the known 'collides below 32' regime) must be rejected up
+    front instead of dying minutes later on a misleading 'never fired'
+    assertion."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak_guard", os.path.join(REPO, "scripts", "chaos_soak.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for budget in ("8", "24"):  # cap <= 0 / collision at the cap
+        with pytest.raises(SystemExit) as exc:
+            mod.main(["--budget-steps", budget])
+        assert exc.value.code == 2  # argparse error, not an assertion
+
+
+def test_soak_schedule_sites_land_on_commit_cadence():
+    """The soak's silent-corruption sites only fire at commit steps:
+    their headroom caps must stay cadence-aligned for ANY budget, not
+    just budgets that are multiples of the checkpoint interval."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak", os.path.join(REPO, "scripts", "chaos_soak.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    interval = 4
+    for budget in (30, 32, 34):
+        for seed in range(3):
+            commits = {}
+            for site, s in mod.sample_schedule(seed, budget, interval, 5):
+                if site == "ckpt_shard_corrupt":
+                    at = int(s.split("step=", 1)[1].split(";", 1)[0])
+                    assert at % interval == 0 and at >= interval, (
+                        budget, seed, s
+                    )
+                    commits[site] = at
+                elif site == "sdc_grad_flip":
+                    at = int(s.split("step=", 1)[1].split(":", 1)[0])
+                    assert (at - 1) % interval == 0 and at > 1, (
+                        budget, seed, s
+                    )
+                    commits[site] = at - 1
+            # the two corruption sites must land on DISTINCT commit
+            # steps, or their fault sequences stack into one incarnation.
+            # Budget 30 is a colliding budget (the CLI guard rejects it
+            # up front — the 'collides below 32' regime); 32+ must
+            # place them apart.
+            if budget >= 32:
+                assert len(set(commits.values())) == 2, (
+                    budget, seed, commits
+                )
+
+
+def test_divergence_minority_attribution():
+    """The actionable line blames the MINORITY fingerprint — including
+    when process/slice 0 is the corrupted one — and reports an exact
+    tie symmetrically instead of guessing."""
+    from fms_fsdp_tpu.resilience.divergence import _minority
+
+    # corrupt replica is process 0: the minority is [0], not [1, 2]
+    odd, tied = _minority([0, 1, 2], [111, 222, 222])
+    assert odd == [0] and tied is None
+    odd, tied = _minority([0, 1, 2], [222, 222, 111])
+    assert odd == [2]
+    # 2-way tie (the 2-process world): no majority, show the split
+    odd, tied = _minority([0, 1], [111, 222])
+    assert odd is None and tied == {111: [0], 222: [1]}
+
+
+def test_candidate_paths_skip_quarantined(tmp_path):
+    from fms_fsdp_tpu.utils.checkpointing import Checkpointer
+
+    _committed_dir(tmp_path, 4)
+    bad = _committed_dir(tmp_path, 8)
+    ck = Checkpointer.__new__(Checkpointer)  # path logic only
+    cands = ck._candidate_ckp_paths(str(tmp_path / "checkpoints"))
+    assert [os.path.basename(c) for c in cands] == [
+        "step_8_ckp", "step_4_ckp"
+    ]
+    quarantine_checkpoint(str(bad), ["checksum mismatch state/data.bin"],
+                          report=lambda m: None)
+    cands = ck._candidate_ckp_paths(str(tmp_path / "checkpoints"))
+    assert [os.path.basename(c) for c in cands] == ["step_4_ckp"]
+
+
+def test_recommit_clears_stale_sidecars(tmp_path):
+    d = _committed_dir(tmp_path, 4)
+    quarantine_checkpoint(str(d), ["checksum mismatch x"],
+                          report=lambda m: None)
+    (d / scrub.VERDICT_NAME).write_text("{}")
+    assert is_quarantined(str(d))
+    clear_integrity_sidecars(str(d))
+    assert not is_quarantined(str(d))
+    assert not os.path.exists(d / scrub.VERDICT_NAME)
+
+
+def test_scrubber_cadence_and_counters(tmp_path):
+    _committed_dir(tmp_path, 4)
+    _committed_dir(tmp_path, 8)
+    s = CheckpointScrubber(
+        [str(tmp_path / "checkpoints")], interval_steps=10,
+        report=lambda m: None,
+    )
+    assert s.enabled
+    assert s.maybe_scrub(10)
+    s.stop()
+    assert not s.maybe_scrub(15)  # inside the cadence window
+    assert s.maybe_scrub(20)
+    s.stop()
+    assert s.last_counts["verified"] == 2
+    assert scrub.total_verified() == 2
+    # disabled forms
+    assert not CheckpointScrubber([], 10).enabled
+    assert not CheckpointScrubber(["x"], 0).enabled
+
+
+def test_load_routes_around_flipped_shard_and_caches_verdicts(
+    tmp_path, capsys
+):
+    """The e2e fallback: a size-preserving flip in the newest committed
+    checkpoint is detected at load (full-content verify), the dir is
+    quarantined with the actionable line, and the restore falls back to
+    the previous commit. A second Checkpointer never re-hashes: the
+    sidecars route it."""
+    from tests.test_resilience import _ckpt_fixtures
+
+    state, ck = _ckpt_fixtures(tmp_path)
+    ck.save(2, state, None, tokens_seen=20)
+    ck.save(4, state, None, tokens_seen=40)
+    step4 = str(tmp_path / "checkpoints" / "step_4_ckp")
+    # flip a byte inside a manifest-recorded file (size unchanged)
+    with open(os.path.join(step4, "manifest.json")) as f:
+        recorded = json.load(f)["files"]
+    rel = max(recorded, key=recorded.get)
+    _flip_byte(os.path.join(step4, rel))
+
+    loaded, _, step, ntok, resuming = ck.load(state, None)
+    out = capsys.readouterr().out
+    assert resuming and step == 2 and ntok == 20
+    assert "checksum mismatch" in out and "quarantined" in out, out
+    assert is_quarantined(step4)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # fresh process (cache dropped): the quarantine marker alone routes
+    # the walk — step_4 never re-enters the candidate list
+    scrub.reset_cache()
+    _, _, step, ntok, _ = ck.load(state, None)
+    assert step == 2 and ntok == 20
+
+
+# ---- fault sites -----------------------------------------------------------
+
+
+def test_ckpt_shard_corrupt_fault_site(tmp_path, capsys):
+    """The injected size-preserving flip: fires post-commit, preserves
+    the size, and the very next verification catches it."""
+    from tests.test_resilience import _ckpt_fixtures
+
+    state, ck = _ckpt_fixtures(tmp_path)
+    ck.save(2, state, None, tokens_seen=20)
+    configure_faults("ckpt_shard_corrupt:step=4")
+    ck.save(4, state, None, tokens_seen=40)
+    configure_faults("")
+    out = capsys.readouterr().out
+    assert "ckpt_shard_corrupt fault: flipped" in out, out
+    step4 = str(tmp_path / "checkpoints" / "step_4_ckp")
+    ok, problems = verify_manifest(step4)
+    assert not ok and any("checksum mismatch" in p for p in problems)
+    # and the restore falls back (the chaos-soak path)
+    _, _, step, ntok, resuming = ck.load(state, None)
+    assert resuming and step == 2 and ntok == 20
+
+
+def test_sdc_grad_flip_site_is_host_side_and_proc_filtered():
+    """The sdc injection perturbs exactly one leaf of the local state,
+    entirely host-side (zero compiled-program changes — the trace-level
+    variant was measured to shift XLA rounding on every step), and the
+    ``proc`` filter gates who fires."""
+    from fms_fsdp_tpu.resilience.divergence import inject_sdc
+    from fms_fsdp_tpu.resilience.faults import fire_fault
+
+    state = {
+        "params": {
+            "big": jnp.arange(64.0, dtype=jnp.float32),
+            "small": jnp.arange(4.0, dtype=jnp.float32),
+        },
+        "step": jnp.zeros((), jnp.int32),
+    }
+    before = params_checksum(state)
+    new_state, key = inject_sdc(state, scale=2.0)
+    assert "big" in key  # the LARGEST leaf is the victim
+    np.testing.assert_array_equal(
+        np.asarray(new_state["params"]["big"]),
+        np.asarray(state["params"]["big"]) * 2.0,
+    )
+    # every other leaf is untouched...
+    np.testing.assert_array_equal(
+        np.asarray(new_state["params"]["small"]),
+        np.asarray(state["params"]["small"]),
+    )
+    assert new_state["params"]["big"].dtype == jnp.float32
+    # ...and the whole-params checksum sees the corruption (the
+    # detector's job: corruption stays confined to the leaves it hit,
+    # so only a whole-tree digest can catch it)
+    assert params_checksum(new_state) != before
+
+    # proc filter: equality against the loop's rank context
+    configure_faults("sdc_grad_flip:step=5:proc=1")
+    assert fire_fault("sdc_grad_flip", step=5, proc=0) is None
+    assert fire_fault("sdc_grad_flip", step=4, proc=1) is None
+    assert fire_fault("sdc_grad_flip", step=5, proc=1) is not None
+    configure_faults("")
+
+
+# ---- divergence detection --------------------------------------------------
+
+
+def test_divergence_fingerprint_units():
+    state = {
+        "params": {
+            "big": jnp.arange(64.0),
+            "small": jnp.arange(4.0),
+        }
+    }
+    d1 = params_checksum(state)
+    assert d1 == params_checksum(state)  # deterministic
+    # corruption ANYWHERE in the tree moves the checksum — a one-bit
+    # flip included (exact integer arithmetic, no float rounding)
+    small_flip = {
+        "params": {"big": jnp.arange(64.0), "small": jnp.arange(4.0) + 1}
+    }
+    assert params_checksum(small_flip) != d1
+    big = np.arange(64.0, dtype=np.float32)
+    big_view = big.view(np.uint32)
+    big_view[17] ^= 1  # single-bit flip in one element
+    bit_flip = {
+        "params": {"big": jnp.asarray(big), "small": jnp.arange(4.0)}
+    }
+    assert params_checksum(bit_flip) != d1
+    # mixed dtypes are folded, not rejected
+    mixed = {
+        "params": {
+            "big": jnp.arange(64.0).astype(jnp.bfloat16),
+            "small": jnp.arange(4, dtype=jnp.int32),
+        }
+    }
+    assert isinstance(params_checksum(mixed), int)
+    # OPTIMIZER state is covered too: SDC in a replicated Adam moment
+    # reaches params only a step later, and a commit in between would
+    # persist the poison — the compare must see it while it disagrees
+    full = {
+        "params": {"w": jnp.arange(8.0)},
+        "opt_state": {"mu": jnp.arange(8.0), "nu": jnp.arange(8.0)},
+    }
+    d_full = params_checksum(full)
+    opt_flip = {
+        "params": {"w": jnp.arange(8.0)},
+        "opt_state": {"mu": jnp.arange(8.0) + 1, "nu": jnp.arange(8.0)},
+    }
+    assert params_checksum(opt_flip) != d_full
+    assert scalar_digest(1.0, 2.0) == scalar_digest(1.0, 2.0)
+    assert scalar_digest(1.0, 2.0) != scalar_digest(1.0, 2.0 + 1e-12)
+
+
+def test_verified_resume_env_parses_falsy_values(monkeypatch):
+    """FMS_VERIFIED_RESUME is a boolean flag: an operator exporting =0
+    to opt OUT during an incident must not accidentally enable it."""
+    from fms_fsdp_tpu.resilience.scrub import (
+        ENV_VERIFIED_RESUME,
+        verified_resume_active,
+    )
+
+    for val, expect in (
+        ("", False), ("0", False), ("false", False), ("False", False),
+        ("no", False), ("off", False),
+        ("1", True), ("true", True), ("yes", True),
+    ):
+        monkeypatch.setenv(ENV_VERIFIED_RESUME, val)
+        assert verified_resume_active() is expect, (val, expect)
+    monkeypatch.delenv(ENV_VERIFIED_RESUME)
+    assert verified_resume_active() is False
+
+
+def test_divergence_due_cadence():
+    assert not divergence_due(10, 0, 0)  # disabled
+    assert divergence_due(10, None, 2)
+    assert divergence_due(10, 8, 2)
+    assert not divergence_due(10, 9, 2)
+
+
+def test_check_divergence_single_process_noop():
+    state = {"params": {"w": jnp.arange(4.0)}}
+    assert check_divergence(state, 1.0, 2.0, 10) is True
+    assert divergence_mod.total_checks() == 0
+
+
+def test_state_divergence_exit_classification():
+    assert EXIT_CODES["state_divergence"] == 9
+    assert (
+        classify_exception(StateDivergenceError("replicas disagree"))
+        == "state_divergence"
+    )
+    # the cause outranks its echoes (a peer wedged in the allgather can
+    # die as a watchdog stall)
+    assert classify_world([9, 2]) == "state_divergence"
+    assert classify_world([9, 3]) == "state_divergence"
+
+
+def test_supervisor_verified_resume_policy(tmp_path):
+    """A state_divergence exit flips every LATER incarnation into
+    verified-resume mode (sticky), visible to the command builder."""
+    from fms_fsdp_tpu.resilience.supervisor import RunSupervisor
+
+    hb = str(tmp_path / "hb.json")
+    script = [([9, 9], 10), ([0, 0], 100)]
+    seen = []
+
+    def launch(specs, attempt, run_id):
+        codes, step = script.pop(0)
+        with open(hb, "w") as f:
+            json.dump({"step": step, "run_id": run_id}, f)
+        return codes
+
+    sup = RunSupervisor(
+        lambda ctx: seen.append(ctx["verified_resume"]) or [["cmd"]],
+        ledger_path=str(tmp_path / "ledger.json"),
+        heartbeat_path=hb,
+        target_step=100,
+        launch=launch,
+        sleep=lambda s: None,
+        log=lambda m: None,
+    )
+    res = sup.run()
+    assert res.status == "completed" and res.restarts == 1
+    assert seen == [False, True]
+    assert sup.entries[0].classification == "state_divergence"
+    assert "verified-resume" in sup.entries[0].note
+
+
+# ---- gloo e2e --------------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _marked_corpus(root, n_shards=4, docs_per_shard=200, doc_len=40):
+    import pyarrow as pa
+
+    root = str(root)
+    os.makedirs(os.path.join(root, "dataset_1"), exist_ok=True)
+    schema = pa.schema([pa.field("tokens", pa.uint32())])
+    rows = []
+    d = 0
+    for s in range(n_shards):
+        path = os.path.join(root, "dataset_1", f"shard_{s}.arrow")
+        with pa.ipc.new_file(path, schema) as w:
+            for _ in range(docs_per_shard):
+                body = [(d * 31 + j) % 997 + 1 for j in range(doc_len - 1)]
+                w.write(pa.record_batch([[MARKER_BASE + d] + body], schema))
+                d += 1
+        rows.append((f"/dataset_1/shard_{s}.arrow", docs_per_shard,
+                     docs_per_shard * doc_len))
+    os.makedirs(os.path.join(root, "meta"), exist_ok=True)
+    with open(os.path.join(root, "meta", "combined_counts.csv"), "w") as f:
+        f.write("dataset/filename,documents,tokens\n")
+        for name, docs, toks in rows:
+            f.write(f"{name},{docs},{toks}\n")
+    return root
+
+
+# the PRE-EXISTING gloo/coordination startup intermittent on loaded 1-2
+# core hosts (see docs/resilience.md and the supervisor e2e, which heal
+# it with a classified bounded retry in production): the world dies by
+# signal before ANY child starts training. Only that exact shape is
+# retried — a child that printed START_STEP made progress, and retrying
+# over its committed state would pollute the walk the asserts read.
+_STARTUP_RACE_SIGS = (
+    "gloo::EnforceNotMet",
+    "Polled an error from coordination service",
+)
+
+
+def _launch_world(n_procs, argv, timeout=600, retries=2):
+    for attempt in range(retries + 1):
+        port = _free_port()
+        procs = []
+        for pid in range(n_procs):
+            env = dict(os.environ)
+            env.update(
+                JAX_PLATFORMS="cpu",
+                XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+                NUM_PROCESSES=str(n_procs),
+                PROCESS_ID=str(pid),
+            )
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-u", CHILD, *argv],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    env=env,
+                    cwd=REPO,
+                )
+            )
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append(out)
+        codes = [p.returncode for p in procs]
+        startup_race = (
+            attempt < retries
+            and any(c < 0 for c in codes)  # signal death, never a verdict
+            and not any("START_STEP" in out for out in outs)
+            and any(
+                sig in out for out in outs for sig in _STARTUP_RACE_SIGS
+            )
+        )
+        if not startup_race:
+            return codes, outs
+        print(f"gloo startup race (codes {codes}); relaunching the world")
+    raise AssertionError("unreachable")
+
+
+@pytest.mark.slow
+def test_divergence_detection_gloo_e2e(tmp_path):
+    """Agreement/disagreement on a real 2-process gloo world (2 slices x
+    1 host): the clean run's fingerprint compares all agree and the run
+    completes; with sdc_grad_flip perturbing process 1's gradient at
+    step 5, the compare at the next report boundary detects the
+    diverged replica and every process exits classified
+    state_divergence (exit 9) without committing the poison."""
+    data = _marked_corpus(tmp_path / "data")
+    overrides = [
+        "num_slices=2",
+        "feed_prefetch=0",
+        "divergence_check_interval=2",
+    ]
+
+    # agreement: replicas agree at every compare, the run completes,
+    # and the metrics record counts the checks
+    ckpt = str(tmp_path / "ckpt_clean")
+    obs = str(tmp_path / "obs_clean")
+    codes, outs = _launch_world(
+        2,
+        [ckpt, data, str(tmp_path / "walk"), "clean", "8", "4", "",
+         f"obs_dir={obs}", *overrides],
+    )
+    assert codes == [0, 0], outs[0][-3000:]
+    assert "ELASTIC_CHILD_DONE" in outs[0]
+    with open(os.path.join(obs, "metrics.jsonl")) as f:
+        rec = json.loads(f.read().splitlines()[-1])
+    assert rec["divergence_checks"] >= 1
+    assert "integrity.divergence_detected" not in rec["extra"]
+
+    # disagreement: one process's gradient flipped at step 5; detection
+    # at the step-6 report boundary, before the step-8 commit
+    ckpt = str(tmp_path / "ckpt_sdc")
+    obs_sdc = str(tmp_path / "obs_sdc")
+    codes, outs = _launch_world(
+        2,
+        [ckpt, data, str(tmp_path / "walk"), "sdc", "8", "4",
+         "sdc_grad_flip:step=5:proc=1", f"obs_dir={obs_sdc}", *overrides],
+    )
+    assert codes == [9, 9], (codes, outs[0][-3000:])
+    assert any(
+        "state divergence detected at step 6" in out for out in outs
+    ), outs[0][-3000:]
+    assert any("exit classified: state_divergence" in out for out in outs)
+    # the detection boundary drains one final record before the abort,
+    # so integrity.divergence_detected actually lands in a sink
+    with open(os.path.join(obs_sdc, "metrics.jsonl")) as f:
+        rec = json.loads(f.read().splitlines()[-1])
+    assert rec["extra"].get("integrity.divergence_detected") == 1, rec
+    # the poisoned update never committed: only the step-4 checkpoint
+    # (pre-flip) exists
+    steps = sorted(
+        x for x in os.listdir(os.path.join(ckpt, "checkpoints"))
+        if x.endswith("_ckp") and "metadata.json" in os.listdir(
+            os.path.join(ckpt, "checkpoints", x)
+        )
+    )
+    assert steps == ["step_4_ckp"], steps

@@ -759,6 +759,82 @@ def test_loader_honors_trainer_resolved_dir_on_any_tier(tmp_path):
     assert not getattr(ds, "_explicit_restore", False)
 
 
+def test_loader_honors_trainer_resolved_fresh_start(tmp_path):
+    """The resolver's other verdict (chaos-soak regression): when the
+    trainer resolves NO restorable checkpoint and starts from scratch,
+    the empty-path marker must suppress the dataset's own auto-detect —
+    a loader auto-save in the save dir (written on the dataset's
+    interval cadence whether or not the model commit ever completed)
+    would otherwise resume the walk under fresh model state, shifting
+    the consumed stream of the whole restarted run."""
+    from fms_fsdp_tpu.data.buffering import CheckpointDataset
+    from fms_fsdp_tpu.data.stateful import StatefulDataset
+
+    class _Stub(StatefulDataset):
+        def __init__(self):
+            super().__init__("/tmp", 0, 1)
+            self.loaded = []
+
+        def load_from_path(self, path):
+            self.loaded.append(path)
+
+    # stale loader auto-save from a torn commit in the save dir
+    stale = tmp_path / "save" / "checkpoints" / "step_4_ckp"
+    os.makedirs(stale)
+    (stale / "loader_state_0.pkl").write_bytes(b"x")
+
+    stub = _Stub()
+    ds = CheckpointDataset(stub, str(tmp_path / "save"), 4)
+    ds.load_from_path("")
+    assert stub.loaded == [] and ds.step == 0
+    assert getattr(ds, "_explicit_restore", False)
+    ds.setup()  # the auto-load the marker must keep suppressed
+    assert stub.loaded == []
+
+    # sanity: the same on-disk state without the marker IS auto-detected
+    # (the legacy restarted-job behavior the regression hid behind)
+    stub2 = _Stub()
+    ds2 = CheckpointDataset(stub2, str(tmp_path / "save"), 4)
+    ds2.setup()
+    assert stub2.loaded == [str(stale)]
+
+
+def test_fresh_start_still_honors_external_load_root(tmp_path):
+    """``resuming_dataset=True`` (continued pretraining): load_path
+    points at a PREVIOUS run's checkpoints. The from-scratch verdict
+    only rules out THIS run's own save dir — external loader state
+    belongs to a different run and cannot outrun this run's model
+    state, so it must still load, with the step count reset exactly as
+    any external restore resets it."""
+    from fms_fsdp_tpu.data.buffering import CheckpointDataset
+    from fms_fsdp_tpu.data.stateful import StatefulDataset
+
+    class _Stub(StatefulDataset):
+        def __init__(self):
+            super().__init__("/tmp", 0, 1)
+            self.loaded = []
+
+        def load_from_path(self, path):
+            self.loaded.append(path)
+
+    prev = tmp_path / "prev_run" / "checkpoints" / "step_6_ckp"
+    os.makedirs(prev)
+    (prev / "loader_state_0.pkl").write_bytes(b"x")
+    # a stale auto-save in THIS run's save dir must still be ignored
+    stale = tmp_path / "save" / "checkpoints" / "step_4_ckp"
+    os.makedirs(stale)
+    (stale / "loader_state_0.pkl").write_bytes(b"x")
+
+    stub = _Stub()
+    ds = CheckpointDataset(
+        stub, str(tmp_path / "prev_run"), 4,
+        save_path=str(tmp_path / "save"),
+    )
+    ds.load_from_path("")
+    assert stub.loaded == [str(prev)]
+    assert ds.step == 0  # external checkpoint: the schedule restarts
+
+
 # ---- slow gloo e2e ---------------------------------------------------------
 
 
@@ -1072,12 +1148,15 @@ def test_supervisor_autorestart_precommit_kill_e2e(tmp_path):
 
 @pytest.mark.slow
 def test_chaos_soak_smoke(tmp_path):
-    """The full seeded chaos soak at a reduced budget: >=3 distinct
-    fault sites including a whole-slice loss, auto-restarted end to end
-    by the supervisor, end state bit-identical to the fault-free run,
-    zero replayed documents, downtime charged to goodput. CI runs the
-    script directly at --budget-steps 24; this smoke keeps it
-    runnable under pytest."""
+    """The full seeded chaos soak: >=5 distinct fault sites including a
+    whole-slice loss, a whole-corpus loss, and the two silent-corruption
+    classes (post-commit shard bit-flip, one-replica SDC),
+    auto-restarted end to end by the supervisor, end state bit-identical
+    to the fault-free run, zero replayed documents, downtime charged to
+    goodput. CI runs the script directly at --budget-steps 32; this
+    smoke keeps it runnable under pytest. (The always-scheduled site
+    list needs the full 32-step budget: the capped commit-aligned fire
+    positions collide below it.)"""
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
@@ -1088,7 +1167,7 @@ def test_chaos_soak_smoke(tmp_path):
     rc = cs.main(
         [
             "--seed", "0",
-            "--budget-steps", "16",
+            "--budget-steps", "32",
             "--workdir", str(tmp_path / "soak"),
         ]
     )
