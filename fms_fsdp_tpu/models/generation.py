@@ -22,6 +22,7 @@ from jax import lax
 from fms_fsdp_tpu.models.configs import LlamaConfig
 from fms_fsdp_tpu.models.llama import llama_forward
 from fms_fsdp_tpu.ops.norms import rms_norm
+from fms_fsdp_tpu.ops.paged_attention import gqa_attend
 from fms_fsdp_tpu.ops.rope import apply_rotary, rope_table
 
 
@@ -73,13 +74,38 @@ def prefill(
     return logits, embeds, {"k": k_cache, "v": v_cache}
 
 
+def decode_layer_qkv(x, layer, cfg: LlamaConfig, cos, sin, positions):
+    """Pre-attention half of one decode layer: norm -> q/k/v projections
+    -> rotary at ``positions``. Shared by the dense decode path below and
+    the paged decode path (fms_fsdp_tpu/serve/decode.py) so both run the
+    exact same ops — the bit-parity contract between them."""
+    b, m = x.shape[:2]
+    hd, nkv = cfg.head_dim, cfg.n_kv_heads
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = (h @ layer["wq"]).reshape(b, m, cfg.nheads, hd)
+    k = (h @ layer["wk"]).reshape(b, m, nkv, hd)
+    v = (h @ layer["wv"]).reshape(b, m, nkv, hd)
+    q = apply_rotary(q, cos, sin, positions)
+    k = apply_rotary(k, cos, sin, positions)
+    return q, k, v
+
+
+def decode_layer_out(x, layer, cfg: LlamaConfig, o):
+    """Post-attention half of one decode layer: residual + SwiGLU FFN.
+    Shared with the paged decode path (see decode_layer_qkv)."""
+    x = x + o @ layer["wo"]
+    h2 = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+    ffn = (jax.nn.silu(h2 @ layer["w1"]) * (h2 @ layer["w3"])) @ layer["w2"]
+    return x + ffn
+
+
 def decode_chunk(params, cache, tokens, pos, cfg: LlamaConfig, compute_dtype=jnp.bfloat16):
     """Cached decode of m tokens at positions pos..pos+m-1 in one forward
     (the verification step of speculative decoding; decode_step is the
     m=1 case). Returns (logits (B, m, V), embeds (B, m, D), cache)."""
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     b, m = tokens.shape
-    hd, nkv = cfg.head_dim, cfg.n_kv_heads
+    hd = cfg.head_dim
     max_seq = cache["k"].shape[2]
 
     cos, sin = rope_table(max_seq, hd, cfg.rope_theta)
@@ -87,37 +113,14 @@ def decode_chunk(params, cache, tokens, pos, cfg: LlamaConfig, compute_dtype=jnp
     positions = jnp.broadcast_to(positions, (b, m))
     x = params["embedding"][tokens]
 
-    def attend(q, k_cache, v_cache):
-        # q position pos+i sees cache entries <= pos+i
-        nq = cfg.nheads
-        group = nq // nkv
-        s = k_cache.shape[1]
-        qg = q.reshape(b, m, nkv, group, hd)
-        scores = jnp.einsum(
-            "bmkgh,bskh->bkgms", qg, k_cache, preferred_element_type=jnp.float32
-        ) * (hd**-0.5)
-        idx = jnp.arange(s)[None, None, None, None, :]
-        qpos = positions[:, None, None, :, None]
-        scores = jnp.where(idx <= qpos, scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        out = jnp.einsum("bkgms,bskh->bmkgh", probs, v_cache)
-        return out.reshape(b, m, nq * hd)
-
     def body(x, inp):
         layer, k_cache, v_cache = inp
-        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = (h @ layer["wq"]).reshape(b, m, cfg.nheads, hd)
-        k = (h @ layer["wk"]).reshape(b, m, nkv, hd)
-        v = (h @ layer["wv"]).reshape(b, m, nkv, hd)
-        q = apply_rotary(q, cos, sin, positions)
-        k = apply_rotary(k, cos, sin, positions)
+        q, k, v = decode_layer_qkv(x, layer, cfg, cos, sin, positions)
         k_cache = lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
         v_cache = lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-        o = attend(q, k_cache, v_cache)
-        x = x + o @ layer["wo"]
-        h2 = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
-        ffn = (jax.nn.silu(h2 @ layer["w1"]) * (h2 @ layer["w3"])) @ layer["w2"]
-        return x + ffn, (k_cache, v_cache)
+        # q position pos+i sees cache entries <= pos+i
+        o = gqa_attend(q, k_cache, v_cache, positions)
+        return decode_layer_out(x, layer, cfg, o), (k_cache, v_cache)
 
     x, (k_cache, v_cache) = lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
@@ -137,7 +140,11 @@ def decode_step(params, cache, token, pos, cfg: LlamaConfig, compute_dtype=jnp.b
     return logits[:, 0], embeds[:, 0], cache
 
 
-def _sample(logits, key, temperature, top_k, do_sample):
+def sample_token(logits, key, temperature, top_k, do_sample):
+    """Greedy argmax or temperature / top-k sampling of one token per
+    row. Public: the serving engine (fms_fsdp_tpu/serve/engine.py) uses
+    the same sampler as ``generate`` so greedy serving is token-for-token
+    the dense path."""
     if not do_sample:
         return jnp.argmax(logits, axis=-1)
     logits = logits.astype(jnp.float32) / temperature
@@ -145,6 +152,9 @@ def _sample(logits, key, temperature, top_k, do_sample):
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1)
+
+
+_sample = sample_token
 
 
 @functools.partial(

@@ -135,6 +135,7 @@ class Observer:
         memory_reserved_bytes: Optional[int] = None,
         memory_allocated_bytes: Optional[int] = None,
         data_mix: Optional[Dict[str, float]] = None,
+        serving: Optional[Dict[str, float]] = None,
         extra: Optional[Dict[str, float]] = None,
     ) -> Dict:
         """Close the phase window, derive goodput/MFU, emit to sinks.
@@ -227,6 +228,9 @@ class Observer:
             # v7: per-corpus data-mix accounting ("<corpus>.<stat>"
             # flat map); None when the run has no live mixing layer
             "data_mix": dict(data_mix) if data_mix else None,
+            # v9: serving-engine headline map
+            # (ServingEngine.serving_stats()); None on training runs
+            "serving": dict(serving) if serving else None,
             "kernel_tuning": self.kernel_tuning,
             "quantized_matmuls": self.quantized_matmuls,
             "quantized_reduce": self.quantized_reduce,

@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -92,6 +92,16 @@ SCHEMA_FIELDS = {
     "integrity_verify_s": ("float", True),
     "scrub_verified": ("int", True),
     "divergence_checks": ("int", True),
+    # v9: serving-engine accounting (docs/serving.md). Flat map with
+    # the serving headline stats: tokens_per_s (decode throughput),
+    # ttft_s (mean time-to-first-token of the window), queue_depth,
+    # kv_pages_in_use, requests_completed / evicted / expired, and
+    # p99_latency_s — filled from ServingEngine.serving_stats() when a
+    # serving loop drives the observer. The full serve.* counter/gauge
+    # set (serve.decode_tokens, serve.kv_defrag_moves, ...) rides in
+    # ``extra`` via the registry snapshot as usual. Absent (null) on
+    # training runs.
+    "serving": ("map", False),
     # v6: self-healing supervisor accounting (docs/resilience.md
     # "Self-healing supervisor"). The relaunched run reads the
     # supervisor's restart ledger (FMS_RESTART_LEDGER) at observer
@@ -154,6 +164,10 @@ SCHEMA_DIGESTS = {
     # (state-integrity layer: manifest verification time, scrub-verified
     # checkpoint count, cross-replica fingerprint compares)
     8: "96ce592c9a1e990018a24d93757370679c594bfac64269b225cd2ff635ee4a3e",
+    # v9: + serving (serving-engine headline map: tokens_per_s, ttft_s,
+    # queue_depth, kv_pages_in_use, request outcome counts,
+    # p99_latency_s — docs/serving.md)
+    9: "178c0ec2d1d31834a0ae939d0df6e734ce66665f0dfccb662ab97dcc5fcc4e12",
 }
 
 

@@ -1,0 +1,278 @@
+"""Ragged paged-attention decode (serving path).
+
+The serving engine (fms_fsdp_tpu/serve/) stores the kv cache in
+fixed-size *pages* — (page_size, Nkv, H) tiles scattered through a
+shared pool — with a per-sequence page table mapping logical cache
+positions to pool pages. Decode-time attention then has two jobs the
+training kernels never had: gather k/v *through the page table*, and
+handle *ragged* sequence lengths (every batch row sits at its own
+position) in one batched call. This module follows *Ragged Paged
+Attention: A High-Performance and Flexible LLM Inference Kernel for TPU*
+(PAPERS.md): one kernel invocation serves the whole mixed-length decode
+batch; per-row length masking replaces per-row dispatch.
+
+Two implementations, one contract:
+
+- ``paged_attention_reference``: pure JAX — gather the pages back into a
+  contiguous (B, S, Nkv, H) cache and run :func:`gqa_attend`, the exact
+  attend math the dense decode path (models/generation.py::decode_chunk)
+  uses. Because the gathered array is bit-identical to the dense cache
+  (the serve allocator points unwritten table slots at a pristine zero
+  page), the reference path is **bit-identical** to dense decode — the
+  correctness anchor tier-1 pins on CPU.
+- ``_paged_decode_kernel``: the Pallas kernel — grid (batch, kv-head,
+  page); the page table rides as scalar prefetch so each cell's k/v
+  block is DMA'd straight from its pool page (no contiguous copy ever
+  materializes), with the FlashAttention-2 online softmax accumulated in
+  VMEM scratch across the page walk. Pages past a row's length run no
+  compute (pl.when) and fetch no data (the index map clamps onto the
+  last live page — a repeat fetch Mosaic elides), which is what makes
+  the ragged batch one kernel call instead of B.
+
+Tile resolution (page_size at allocator build, block_kv per call) goes
+through the tuning table (fms_fsdp_tpu/tune/lookup.py::
+resolve_paged_decode) like every other kernel. v1 constraint: the
+kernel walks one page per grid step, so ``block_kv == page_size``; the
+cost model already prices larger multi-page blocks (manual-DMA fetch)
+so committed tables stay forward-compatible.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fms_fsdp_tpu.ops.pallas_mode import interpret_default
+from fms_fsdp_tpu.parallel.compat import tpu_compiler_params
+
+NEG_INF = -1e30
+LOG2E = 1.4426950408889634  # log2(e)
+
+
+# ---------------------------------------------------------------------------
+# shared dense attend math (also the body of decode_chunk's attention)
+# ---------------------------------------------------------------------------
+
+
+def gqa_attend(q, k_cache, v_cache, positions):
+    """Grouped-query attention of m query positions against a cache.
+
+    q (B, m, Nq, H); k_cache/v_cache (B, S, Nkv, H); positions (B, m)
+    int32 — query i of row b sits at positions[b, i] and sees cache
+    entries <= it. Returns (B, m, Nq*H).
+
+    This is the exact attend the dense decode path runs
+    (models/generation.py::decode_chunk imports it); the paged reference
+    below calls it on the gathered cache, which is what makes paged
+    decode bit-identical to dense decode.
+    """
+    b, m, nq, hd = q.shape
+    nkv = k_cache.shape[2]
+    group = nq // nkv
+    s = k_cache.shape[1]
+    qg = q.reshape(b, m, nkv, group, hd)
+    scores = jnp.einsum(
+        "bmkgh,bskh->bkgms", qg, k_cache, preferred_element_type=jnp.float32
+    ) * (hd**-0.5)
+    idx = jnp.arange(s)[None, None, None, None, :]
+    qpos = positions[:, None, None, :, None]
+    scores = jnp.where(idx <= qpos, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bkgms,bskh->bmkgh", probs, v_cache)
+    return out.reshape(b, m, nq * hd)
+
+
+# ---------------------------------------------------------------------------
+# reference (gather) implementation
+# ---------------------------------------------------------------------------
+
+
+def gather_pages(pages, page_table):
+    """pages (P, ps, Nkv, H) + page_table (B, maxp) -> (B, maxp*ps, Nkv, H).
+
+    The contiguous per-sequence view of a paged pool. Table slots past a
+    sequence's allocation point at the reserved zero page, so the
+    gathered array equals the dense cache (zeros beyond the written
+    prefix) bit-for-bit.
+    """
+    b, maxp = page_table.shape
+    ps = pages.shape[1]
+    g = pages[page_table]  # (B, maxp, ps, Nkv, H)
+    return g.reshape(b, maxp * ps, *pages.shape[2:])
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table, seq_lens):
+    """One ragged decode position per row, via gather + dense attend.
+
+    q (B, Nq, H); k_pages/v_pages (P, ps, Nkv, H); page_table (B, maxp)
+    int32; seq_lens (B,) int32 = the position each row's query sits at
+    (it sees cache entries <= seq_lens[b], i.e. seq_lens[b]+1 tokens —
+    the freshly written current token included). Returns (B, Nq*H).
+    """
+    k = gather_pages(k_pages, page_table)
+    v = gather_pages(v_pages, page_table)
+    return gqa_attend(q[:, None], k, v, seq_lens[:, None])[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(
+    lens_ref,  # scalar prefetch: (B,) int32 query positions
+    table_ref,  # scalar prefetch: (B, maxp) int32 page table
+    q_ref,  # (1, 1, group, H)
+    k_ref,  # (1, page_size, 1, H) — one pool page for this kv head
+    v_ref,
+    o_ref,  # (1, 1, group, H)
+    acc_ref,  # VMEM (group, H) fp32
+    m_ref,  # VMEM (group, 1) fp32 running max (base 2)
+    l_ref,  # VMEM (group, 1) fp32 running denominator
+    *,
+    page_size,
+    scale,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+    pos = lens_ref[b]  # query position; attends to cache idx <= pos
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # pages holding no position <= pos run no compute (and fetched no
+    # data: the index map clamped them onto the last live page)
+    run = j * page_size <= pos
+
+    @pl.when(run)
+    def _():
+        # scale + change of base folded into q; exp2 replaces exp in the
+        # online softmax (same trick as ops/flash_attention.py)
+        q = (q_ref[0, 0] * (scale * LOG2E)).astype(q_ref.dtype)  # (G, H)
+        k = k_ref[0, :, 0, :]  # (ps, H)
+        v = v_ref[0, :, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (G, ps), base-2 domain
+        kpos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(kpos <= pos, s, NEG_INF)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = m_new
+
+    @pl.when(j == num_pages - 1)
+    def _():
+        l = l_ref[...]
+        # a row that attended nothing (an idle batch slot) has l == 0;
+        # emit zeros, not 0/0 NaN — its output is discarded either way
+        # but NaN would trip downstream finiteness guards
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / safe).astype(o_ref.dtype)
+
+
+def paged_attention_kernel(
+    q, k_pages, v_pages, page_table, seq_lens, *, interpret=None
+):
+    """Pallas ragged paged-attention decode; contract of
+    :func:`paged_attention_reference` (same shapes, same masking rule).
+
+    Grid (B, Nkv, maxp): the page table and row positions ride as scalar
+    prefetch, so each cell's (1, ps, 1, H) k/v block is fetched straight
+    from pool page ``page_table[b, j]`` — clamped onto the last live
+    page for cells past the row's length, which therefore issue no new
+    DMA. Online-softmax state lives in VMEM scratch across the page walk
+    (the ``arbitrary`` grid dim).
+    """
+    b, nq, hd = q.shape
+    num_pool_pages, page_size, nkv, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    group = nq // nkv
+    scale = hd**-0.5
+    if interpret is None:
+        interpret = interpret_default()
+
+    qg = q.reshape(b, nkv, group, hd)
+
+    def kv_map(b_, h_, j_, lens, table):
+        # clamp dead cells onto the row's last live page (repeat fetch)
+        last = jnp.maximum(lens[b_], 0) // page_size
+        return (table[b_, jnp.minimum(j_, last)], 0, h_, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv, maxp),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, hd), lambda b_, h_, j_, *_: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, page_size, 1, hd), kv_map),
+            pl.BlockSpec((1, page_size, 1, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, group, hd), lambda b_, h_, j_, *_: (b_, h_, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, hd), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, page_size=page_size, scale=scale
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, group, hd), q.dtype),
+        # scratch carries across the page walk; batch/head dims independent
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(seq_lens.astype(jnp.int32), page_table.astype(jnp.int32), qg,
+      k_pages, v_pages)
+    return out.reshape(b, nq * hd)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def paged_attention(
+    q, k_pages, v_pages, page_table, seq_lens, *, impl="auto", interpret=None
+):
+    """Ragged paged-attention decode: q (B, Nq, H) against paged k/v
+    pools -> (B, Nq*H). ``impl``:
+
+    - "reference": gather + dense attend — bit-identical to the dense
+      decode path (the tier-1 parity anchor);
+    - "kernel": the Pallas kernel (interpret mode on CPU);
+    - "auto": kernel on TPU backends, reference elsewhere — CPU serving
+      and tests keep dense bit-parity by default.
+    """
+    if impl == "auto":
+        impl = "reference" if jax.default_backend() != "tpu" else "kernel"
+    if impl == "reference":
+        return paged_attention_reference(
+            q, k_pages, v_pages, page_table, seq_lens
+        )
+    if impl == "kernel":
+        return paged_attention_kernel(
+            q, k_pages, v_pages, page_table, seq_lens, interpret=interpret
+        )
+    raise ValueError(f"unknown paged attention impl: {impl!r}")

@@ -365,6 +365,27 @@ def activation_roundtrip(x, wire: str):
     raise ValueError(f"unknown activation wire: {wire!r}")
 
 
+def kv_quantize(x, wire: str):
+    """KV-cache page storage format (fms_fsdp_tpu/serve/kv_cache.py):
+    per-row absmax along the head (last) dim, int8 grid or **e4m3** fp8 —
+    cache entries are activations, so they take e4m3's mantissa like the
+    attention operand wire above, not the e5m2 gradient wire. Returns
+    (q, scale) with scale keeping the reduced dim as 1; the pair is what
+    a quantized page pool persists (1-byte values + fp32 row scales,
+    halving-plus resident KV bytes vs bf16)."""
+    if wire == "int8":
+        return _absmax_quant(x, axis=-1)
+    if wire == "fp8":
+        return _absmax_quant_fp8(x, axis=-1, dtype=FP8_E4M3)
+    raise ValueError(f"unknown kv wire: {wire!r}")
+
+
+def kv_dequantize(q, scale, dtype):
+    """Inverse of :func:`kv_quantize`: q * scale in fp32, cast to the
+    compute dtype."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 def leaf_amax(g):
     """Current-step absmax of one gradient leaf (fp32 scalar) — the
     value appended to the delayed-scaling amax history."""

@@ -1,0 +1,17 @@
+"""Serving engine v1: paged KV cache, ragged paged-attention decode,
+continuous batching (docs/serving.md)."""
+
+from fms_fsdp_tpu.serve.engine import ServeConfig, ServingEngine
+from fms_fsdp_tpu.serve.kv_cache import PagedKVCache
+from fms_fsdp_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+)
+
+__all__ = [
+    "ContinuousBatchingScheduler",
+    "PagedKVCache",
+    "Request",
+    "ServeConfig",
+    "ServingEngine",
+]

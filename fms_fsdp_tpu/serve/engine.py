@@ -1,0 +1,455 @@
+"""The serving engine: checkpoint -> continuous-batching decode loop.
+
+First slice of the serving story (ROADMAP item 1): single-chip,
+CPU-deterministic, one fixed-shape jitted decode step serving a
+changing request population. The pieces:
+
+- params restored from a training checkpoint (``from_checkpoint`` ->
+  utils/checkpointing.py::load_params_only — a params pickle, a
+  step_N_ckp dir, or a checkpoints/ root; optimizer state is never
+  read);
+- a :class:`~fms_fsdp_tpu.serve.kv_cache.PagedKVCache` pool whose page
+  size resolves through the kernel-tuning table
+  (tune/lookup.py::resolve_paged_decode) at engine build — table or
+  cost model, never a timing sweep;
+- the :class:`~fms_fsdp_tpu.serve.scheduler.ContinuousBatchingScheduler`
+  deciding admission / expiry / eviction each iteration;
+- one jitted ragged decode step (serve/decode.py) over the ``max_batch``
+  slots, pools donated so the update is in-place; prefills run
+  interleaved (at most ``max_prefill_per_step`` per iteration) through
+  models/generation.py::prefill, whose cache scatters into the pages.
+
+Greedy decode on the reference attention impl is bit-identical to
+models/generation.py::generate — the parity anchor
+(tests/test_serving.py). Metrics land on the engine's MetricRegistry
+under ``serve.*`` and fold into the obs record's schema-v9 ``serving``
+map via :meth:`ServingEngine.serving_stats`.
+"""
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fms_fsdp_tpu.models.generation import prefill, sample_token
+from fms_fsdp_tpu.obs.registry import MetricRegistry
+from fms_fsdp_tpu.serve.decode import paged_decode_step
+from fms_fsdp_tpu.serve.kv_cache import RESERVED_PAGES, PagedKVCache
+from fms_fsdp_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+)
+
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+}
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Engine knobs (docs/serving.md has the full table)."""
+
+    max_batch: int = 8  # decode slots (the fixed jit batch shape)
+    max_seq_len: int = 2048  # per-sequence cache capacity
+    num_pages: int = 0  # pool size; 0 = max_batch*max_seq_len + reserved
+    page_size: int = 0  # 0 = resolve via the tuning table / cost model
+    kv_quant: str = "none"  # "none" | "int8" | "fp8" page storage
+    attn_impl: str = "auto"  # "reference" | "kernel" | "auto"
+    compute_dtype: str = "bfloat16"
+    # prompt lengths round up to a multiple of this before prefill
+    # (bounds jit recompiles under diverse lengths); 1 = exact lengths,
+    # which keeps strict dense bit-parity
+    prefill_bucket: int = 1
+    max_prefill_per_step: int = 1  # prefill-decode interleave bound
+    eos_token: Optional[int] = None
+    # sampling (greedy default — the parity mode)
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 10
+
+
+class ServingEngine:
+    def __init__(
+        self,
+        params,
+        model_cfg,
+        serve_cfg: Optional[ServeConfig] = None,
+        registry: Optional[MetricRegistry] = None,
+        clock: Callable[[], float] = time.monotonic,
+        seed: int = 0,
+    ):
+        scfg = serve_cfg or ServeConfig()
+        self.params = params
+        self.model_cfg = model_cfg
+        self.serve_cfg = scfg
+        self.registry = registry or MetricRegistry()
+        self.clock = clock
+        self.compute_dtype = _DTYPES[scfg.compute_dtype]
+
+        nlayers = int(params["layers"]["wq"].shape[0])
+        from fms_fsdp_tpu.tune.lookup import resolve_paged_decode
+
+        page_size, self.block_kv, self.tune_how = resolve_paged_decode(
+            scfg.max_batch,
+            model_cfg.nheads,
+            model_cfg.n_kv_heads,
+            model_cfg.head_dim,
+            scfg.max_seq_len,
+            scfg.compute_dtype,
+            requested_page_size=scfg.page_size or None,
+        )
+        assert scfg.max_seq_len % page_size == 0, (
+            scfg.max_seq_len, page_size
+        )
+        self.page_size = page_size
+        self.max_pages = scfg.max_seq_len // page_size
+        num_pages = scfg.num_pages or (
+            scfg.max_batch * self.max_pages + RESERVED_PAGES
+        )
+        self.cache = PagedKVCache(
+            nlayers,
+            num_pages,
+            page_size,
+            model_cfg.n_kv_heads,
+            model_cfg.head_dim,
+            dtype=self.compute_dtype,
+            quant=scfg.kv_quant,
+        )
+        self.scheduler = ContinuousBatchingScheduler(
+            scfg.max_batch,
+            max_prefill_per_step=scfg.max_prefill_per_step,
+            clock=clock,
+        )
+        impl = scfg.attn_impl
+        if impl == "auto":
+            impl = "reference" if jax.default_backend() != "tpu" else "kernel"
+        if scfg.kv_quant != "none" and impl == "kernel":
+            impl = "reference"  # v1 kernel reads full-width pools
+        self.attn_impl = impl
+
+        self._slots: List[Optional[Request]] = [None] * scfg.max_batch
+        self._admit_order: List[Request] = []
+        self._tokens = np.zeros((scfg.max_batch,), np.int32)
+        self._lens = np.zeros((scfg.max_batch,), np.int32)
+        self._key = jax.random.PRNGKey(seed)
+        self._prefill_cache: Dict = {}
+        self._decode_tokens = 0
+        self._prefill_tokens = 0
+        self._decode_wall = 0.0
+        self._finished_buf: List[Request] = []
+        # cached device page table, keyed on (allocator version, slot
+        # membership): steady-state decode re-uploads nothing
+        self._table_key = None
+        self._table_dev = None
+        self.last_logits = None  # (B, V) of the last decode step (debug)
+
+        cfg = model_cfg
+
+        def _step(params, pools, page_table, seq_lens, tokens, key):
+            logits, _, pools = paged_decode_step(
+                params,
+                pools,
+                page_table,
+                seq_lens,
+                tokens,
+                cfg,
+                page_size=page_size,
+                compute_dtype=self.compute_dtype,
+                quant=scfg.kv_quant,
+                attn_impl=impl,
+            )
+            tok = sample_token(
+                logits, key, scfg.temperature, scfg.top_k, scfg.do_sample
+            )
+            return tok.astype(jnp.int32), logits, pools
+
+        # pools donated: the step's cache update is in-place, never a
+        # pool copy per token
+        self._decode_fn = jax.jit(_step, donate_argnums=(1,))
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(
+        cls, path: str, model_cfg, serve_cfg: Optional[ServeConfig] = None,
+        **kw,
+    ) -> "ServingEngine":
+        """Restore params from a training checkpoint (params pickle,
+        step_N_ckp dir, or a checkpoints/ root — the Checkpointer's
+        committed layout) and build the engine around them."""
+        from fms_fsdp_tpu.models.llama import init_llama_params
+        from fms_fsdp_tpu.utils.checkpointing import load_params_only
+
+        params = load_params_only(
+            path, lambda key: init_llama_params(key, model_cfg)
+        )
+        return cls(params, model_cfg, serve_cfg, **kw)
+
+    # -- request side ------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        deadline_s: Optional[float] = None,
+    ) -> Request:
+        """Queue one request. ``deadline_s`` is relative to now; a
+        request still queued past it is expired unserved."""
+        deadline = None if deadline_s is None else self.clock() + deadline_s
+        # real raises, not asserts: these validate USER input and must
+        # survive python -O — an accepted never-fits request would
+        # head-of-line-block the FIFO queue forever
+        if len(prompt) + max_new_tokens > self.serve_cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({self.serve_cfg.max_seq_len})"
+            )
+        worst = self._padded_len(len(prompt) + max_new_tokens - 1) + 1
+        need = self.cache.pages_needed(worst)
+        total = self.cache.num_pages - RESERVED_PAGES
+        if need > total:
+            raise ValueError(
+                f"request needs up to {need} pages but the pool holds "
+                f"{total}; raise num_pages or shrink "
+                f"prompt/max_new_tokens"
+            )
+        req = self.scheduler.submit(
+            Request(list(prompt), max_new_tokens, deadline)
+        )
+        self.registry.counter("serve.requests_submitted").add()
+        return req
+
+    # -- prefill -----------------------------------------------------------
+
+    def _padded_len(self, n: int) -> int:
+        b = max(1, self.serve_cfg.prefill_bucket)
+        return -(-n // b) * b
+
+    def _get_prefill(self, p_len: int, s_pad: int, full_logits: bool):
+        key = (p_len, s_pad, full_logits)
+        fn = self._prefill_cache.get(key)
+        if fn is None:
+            cfg, dt = self.model_cfg, self.compute_dtype
+
+            fn = jax.jit(
+                partial(
+                    prefill,
+                    cfg=cfg,
+                    max_seq_len=s_pad,
+                    compute_dtype=dt,
+                    full_logits=full_logits,
+                )
+            )
+            self._prefill_cache[key] = fn
+        return fn
+
+    def _prefill_request(self, req: Request, slot: int) -> None:
+        prompt = req.resume_prompt()
+        p = len(prompt)
+        p_pad = self._padded_len(p)
+        s_pad = self.cache.pages_needed(p_pad) * self.page_size
+        ok = self.cache.ensure(req.rid, p_pad)
+        assert ok, "admission checked capacity; ensure cannot fail here"
+        toks = np.zeros((1, p_pad), np.int32)
+        toks[0, :p] = prompt
+        full_logits = p_pad != p
+        logits, _, kv = self._get_prefill(p_pad, s_pad, full_logits)(
+            self.params, jnp.asarray(toks)
+        )
+        # logits of the last REAL position predict the next token
+        row = logits[0, p - 1] if full_logits else logits[0, 0]
+        self.cache.write_prompt(req.rid, kv["k"][:, 0], kv["v"][:, 0])
+        self._key, sub = jax.random.split(self._key)
+        tok = int(
+            sample_token(
+                row[None],
+                sub,
+                self.serve_cfg.temperature,
+                self.serve_cfg.top_k,
+                self.serve_cfg.do_sample,
+            )[0]
+        )
+        now = self.clock()
+        if req.first_token_time is None:
+            req.first_token_time = now
+            self.registry.hist("serve.ttft_s").record(now - req.submit_time)
+        req.generated.append(tok)
+        self._prefill_tokens += p
+        self.registry.counter("serve.prefill_tokens").add(p)
+        self._slots[slot] = req
+        self._admit_order.append(req)
+        self._tokens[slot] = tok
+        self._lens[slot] = p
+        if self._finish_if_done(req, slot, now=now):
+            return
+
+    # -- lifecycle helpers -------------------------------------------------
+
+    def _finish_if_done(self, req: Request, slot: int, now=None) -> bool:
+        done = len(req.generated) >= req.max_new_tokens or (
+            self.serve_cfg.eos_token is not None
+            and req.generated
+            and req.generated[-1] == self.serve_cfg.eos_token
+        )
+        if not done:
+            return False
+        self.scheduler.mark_finished(req, now=now)
+        self._release_slot(req, slot)
+        self._finished_buf.append(req)
+        self.registry.counter("serve.requests_completed").add()
+        self.registry.hist("serve.request_latency_s").record(req.latency)
+        return True
+
+    def _release_slot(self, req: Request, slot: int) -> None:
+        self.cache.free(req.rid)
+        self._slots[slot] = None
+        if req in self._admit_order:
+            self._admit_order.remove(req)
+        self._tokens[slot] = 0
+        self._lens[slot] = 0
+
+    def _evict(self, victim: Request) -> None:
+        slot = self._slots.index(victim)
+        self._release_slot(victim, slot)
+        self.scheduler.mark_evicted(victim)
+        self.registry.counter("serve.requests_evicted").add()
+
+    # -- the engine iteration ----------------------------------------------
+
+    def step(self) -> List[Request]:
+        """One continuous-batching iteration: expire, admit (+prefill),
+        one ragged decode step, harvest finishes. Returns the requests
+        that finished during this iteration."""
+        now = self.clock()
+        for r in self.scheduler.expire_queued(now):
+            self.registry.counter("serve.requests_expired").add()
+
+        def can_fit(req: Request) -> bool:
+            n = self._padded_len(len(req.resume_prompt()))
+            return self.cache.can_ensure(req.rid, n + 1)
+
+        # admit ONE at a time, prefilling (and so allocating) before the
+        # next can_fit evaluation — a single batched admit would check
+        # every candidate against the pre-prefill pool and over-admit
+        # when two requests each fit alone but not together. Slots are
+        # recounted live too: a request that finishes inside its own
+        # prefill releases its slot immediately.
+        for _ in range(self.serve_cfg.max_prefill_per_step):
+            if self._slots.count(None) <= 0:
+                break
+            got = self.scheduler.admit(1, can_fit)
+            if not got:
+                break
+            slot = self._slots.index(None)
+            self._prefill_request(got[0], slot)
+
+        # token-granular page growth; evict (LIFO) when the pool is dry
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            while not self.cache.ensure(req.rid, int(self._lens[slot]) + 1):
+                victim = self.scheduler.evict_victim(self._admit_order)
+                assert victim is not None, "no victim but pool exhausted"
+                self._evict(victim)
+                if victim is req:
+                    break
+
+        active = [
+            (slot, r) for slot, r in enumerate(self._slots) if r is not None
+        ]
+        if active:
+            t0 = self.clock()
+            key = (
+                self.cache.table_version,
+                tuple(r.rid if r is not None else None for r in self._slots),
+            )
+            if key != self._table_key:
+                self._table_key = key
+                self._table_dev = jnp.asarray(
+                    self.cache.page_table(
+                        [r.rid if r is not None else None
+                         for r in self._slots],
+                        self.max_pages,
+                    )
+                )
+            table = self._table_dev
+            self._key, sub = jax.random.split(self._key)
+            toks, logits, pools = self._decode_fn(
+                self.params,
+                self.cache.pools,
+                table,
+                jnp.asarray(self._lens),
+                jnp.asarray(self._tokens),
+                sub,
+            )
+            self.cache.pools = pools
+            toks = np.asarray(toks)
+            self.last_logits = logits
+            self._decode_wall += self.clock() - t0
+            self._decode_tokens += len(active)
+            self.registry.counter("serve.decode_tokens").add(len(active))
+            for slot, req in active:
+                self._lens[slot] += 1
+                tok = int(toks[slot])
+                req.generated.append(tok)
+                self._tokens[slot] = tok
+                self._finish_if_done(req, slot)
+
+        self.registry.gauge("serve.queue_depth").set(
+            self.scheduler.queue_depth()
+        )
+        self.registry.gauge("serve.kv_pages_in_use").set(
+            self.cache.pages_in_use
+        )
+        if self._decode_wall > 0:
+            self.registry.gauge("serve.tokens_per_s").set(
+                self._decode_tokens / self._decode_wall
+            )
+        out, self._finished_buf = self._finished_buf, []
+        return out
+
+    def run(self, max_steps: int = 100000) -> None:
+        """Drive step() until queue and slots drain (or max_steps)."""
+        for _ in range(max_steps):
+            if not self.has_work():
+                return
+            self.step()
+
+    def has_work(self) -> bool:
+        return bool(self.scheduler.queue) or any(
+            r is not None for r in self._slots
+        )
+
+    # -- obs ---------------------------------------------------------------
+
+    def serving_stats(self) -> Dict[str, float]:
+        """The schema-v9 ``serving`` map (flat str->number): headline
+        serving health for one obs record. Registry counters/gauges
+        additionally ride a record's ``extra`` via MetricRegistry
+        snapshot as usual."""
+        ttft = self.registry.hist("serve.ttft_s").reduce(clear=False)
+        # true p99 from the latency window (Hist.reduce only derives
+        # mean/p50/p90/max — max would alarm on a single outlier)
+        lat = sorted(self.registry.hist("serve.request_latency_s").samples)
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] if lat else 0.0
+        return {
+            "tokens_per_s": (
+                self._decode_tokens / self._decode_wall
+                if self._decode_wall > 0
+                else 0.0
+            ),
+            "ttft_s": ttft.get("mean", 0.0),
+            "queue_depth": float(self.scheduler.queue_depth()),
+            "kv_pages_in_use": float(self.cache.pages_in_use),
+            "requests_completed": float(self.scheduler.completed),
+            "requests_evicted": float(self.scheduler.evicted),
+            "requests_expired": float(self.scheduler.expired),
+            "p99_latency_s": p99,
+        }

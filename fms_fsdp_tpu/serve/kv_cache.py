@@ -1,0 +1,275 @@
+"""Paged KV-cache: a fixed-size-page pool with per-sequence page tables.
+
+The serving engine never materializes a (B, S_max, Nkv, H) cache per
+sequence — that layout wastes HBM on every request shorter than S_max
+and couples batch membership to memory layout. Instead the cache is a
+shared pool of fixed-size pages, one pool per k and v:
+
+    pools["k"]: (L, P, page_size, Nkv, H)   P = num_pages
+
+and each sequence owns an ordered list of page ids; logical cache
+position ``t`` of a sequence lives at (pages[t // page_size],
+t % page_size). The page table handed to the decode step is the padded
+(B, max_pages) int32 matrix of those lists.
+
+Reserved pages (the allocator never hands them out):
+
+- page 0, the **zero page**: every unallocated page-table slot points
+  here. It is never written, so gathering a sequence's table yields
+  exactly the dense cache layout — real pages then zeros — which is
+  what makes the reference paged-attention path bit-identical to the
+  dense decode path (ops/paged_attention.py).
+- page 1, the **scratch page**: idle batch slots in the fixed-shape
+  decode step still execute a write; their page-table rows point every
+  slot here so the garbage lands where no live sequence ever reads.
+
+Allocation is host-side Python (deterministic, lowest-index-first via a
+heap) with all-or-nothing semantics: ``ensure`` either extends a
+sequence to the requested capacity or changes nothing and returns False
+— the scheduler turns False into defer-or-evict. ``defrag`` compacts
+allocated pages onto the lowest indices (a gather permutation applied
+to the device pools, page tables rewritten) — paged attention needs no
+contiguity, so this is a locality / pool-shrink maintenance op, with
+moves counted for the obs registry.
+
+Quantized page storage (``quant="int8"|"fp8"``) stores 1-byte values
+plus fp32 per-row scales via the ops/quant.py kv wire format
+(per-(position, kv-head) absmax along the head dim), cutting resident
+KV bytes ~2x at bf16 compute; the reference read path dequantizes only
+the gathered pages, never the pool.
+"""
+
+import heapq
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from fms_fsdp_tpu.ops.quant import kv_quantize
+
+ZERO_PAGE = 0
+SCRATCH_PAGE = 1
+RESERVED_PAGES = 2
+
+_QUANT_STORE_DTYPE = {"int8": jnp.int8, "fp8": jnp.float8_e4m3fn}
+
+
+class PagedKVCache:
+    """Device pools + the host-side page allocator."""
+
+    def __init__(
+        self,
+        n_layers: int,
+        num_pages: int,
+        page_size: int,
+        n_kv_heads: int,
+        head_dim: int,
+        dtype=jnp.bfloat16,
+        quant: str = "none",
+    ):
+        assert num_pages > RESERVED_PAGES, (
+            f"num_pages={num_pages}: pages 0/1 are reserved (zero/scratch), "
+            "the pool needs at least one allocatable page"
+        )
+        if quant not in ("none", "int8", "fp8"):
+            raise ValueError(f"unknown kv cache quant: {quant!r}")
+        self.n_layers = n_layers
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.n_kv_heads = n_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        self.quant = quant
+
+        store = _QUANT_STORE_DTYPE.get(quant, dtype)
+        shape = (n_layers, num_pages, page_size, n_kv_heads, head_dim)
+        self.pools = {
+            "k": jnp.zeros(shape, store),
+            "v": jnp.zeros(shape, store),
+        }
+        if quant != "none":
+            sshape = (n_layers, num_pages, page_size, n_kv_heads, 1)
+            self.pools["k_scale"] = jnp.zeros(sshape, jnp.float32)
+            self.pools["v_scale"] = jnp.zeros(sshape, jnp.float32)
+
+        self._free: List[int] = list(range(RESERVED_PAGES, num_pages))
+        heapq.heapify(self._free)
+        self._seq_pages: Dict[int, List[int]] = {}
+        self._seq_tokens: Dict[int, int] = {}
+        # accounting (drained into serve.* gauges by the engine)
+        self.alloc_count = 0
+        self.free_count = 0
+        self.failed_allocs = 0
+        self.defrag_moves = 0
+        # bumped whenever any page table could have changed (alloc /
+        # free / defrag) — the engine keys its cached device page-table
+        # upload on it so steady-state decode steps (no allocation
+        # events page_size-1 steps out of page_size) re-upload nothing
+        self.table_version = 0
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def pages_in_use(self) -> int:
+        return sum(len(p) for p in self._seq_pages.values())
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def capacity_tokens(self) -> int:
+        return (self.num_pages - RESERVED_PAGES) * self.page_size
+
+    def fragmentation(self) -> float:
+        """Internal fragmentation: the fraction of allocated slots not
+        holding a token (tail waste of each sequence's last page)."""
+        pages = self.pages_in_use
+        if pages == 0:
+            return 0.0
+        slots = pages * self.page_size
+        tokens = sum(self._seq_tokens.values())
+        return (slots - tokens) / slots
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def can_ensure(self, seq_id: int, n_tokens: int) -> bool:
+        have = len(self._seq_pages.get(seq_id, ()))
+        return self.pages_needed(n_tokens) - have <= len(self._free)
+
+    def tokens_of(self, seq_id: int) -> int:
+        return self._seq_tokens.get(seq_id, 0)
+
+    # -- alloc / free ------------------------------------------------------
+
+    def ensure(self, seq_id: int, n_tokens: int) -> bool:
+        """Grow seq_id's allocation to hold ``n_tokens`` cache slots.
+        All-or-nothing: on insufficient free pages nothing changes and
+        False is returned (the scheduler defers or evicts)."""
+        pages = self._seq_pages.setdefault(seq_id, [])
+        need = self.pages_needed(n_tokens) - len(pages)
+        if need > len(self._free):
+            self.failed_allocs += 1
+            return False
+        for _ in range(max(0, need)):
+            pages.append(heapq.heappop(self._free))
+            self.alloc_count += 1
+        if need > 0:
+            self.table_version += 1
+        self._seq_tokens[seq_id] = max(
+            self._seq_tokens.get(seq_id, 0), n_tokens
+        )
+        return True
+
+    def free(self, seq_id: int) -> int:
+        """Release every page of seq_id; returns how many."""
+        pages = self._seq_pages.pop(seq_id, [])
+        self._seq_tokens.pop(seq_id, None)
+        for p in pages:
+            heapq.heappush(self._free, p)
+        self.free_count += len(pages)
+        if pages:
+            self.table_version += 1
+        return len(pages)
+
+    def pages_of(self, seq_id: int) -> List[int]:
+        return list(self._seq_pages.get(seq_id, ()))
+
+    # -- page tables -------------------------------------------------------
+
+    def page_table_row(self, seq_id: Optional[int], max_pages: int):
+        """One padded page-table row: allocated pages, then the zero
+        page (so gathers read zeros past the allocation). ``None`` (an
+        idle batch slot) maps every slot to the scratch page — its
+        fixed-shape decode writes land where nothing live reads."""
+        if seq_id is None:
+            return [SCRATCH_PAGE] * max_pages
+        pages = self._seq_pages.get(seq_id, [])
+        assert len(pages) <= max_pages, (
+            f"sequence {seq_id} holds {len(pages)} pages > max_pages="
+            f"{max_pages} (max_seq_len / page_size mismatch)"
+        )
+        return pages + [ZERO_PAGE] * (max_pages - len(pages))
+
+    def page_table(self, seq_ids: List[Optional[int]], max_pages: int):
+        import numpy as np
+
+        return np.asarray(
+            [self.page_table_row(s, max_pages) for s in seq_ids],
+            dtype=np.int32,
+        )
+
+    # -- writes ------------------------------------------------------------
+
+    def write_prompt(self, seq_id: int, k, v):
+        """Scatter a prefilled (L, S_pad, Nkv, H) k/v pair into seq_id's
+        pages. ``S_pad`` must be a page multiple covering the prompt
+        (positions past the prompt are the prefill's zero padding, which
+        keeps page tails dense-identical). Call ``ensure`` first."""
+        L, s_pad = k.shape[0], k.shape[1]
+        assert s_pad % self.page_size == 0, (s_pad, self.page_size)
+        n = s_pad // self.page_size
+        pages = self._seq_pages.get(seq_id, [])
+        assert n <= len(pages), (
+            f"write_prompt needs {n} pages, sequence {seq_id} holds "
+            f"{len(pages)} — call ensure() first"
+        )
+        ids = jnp.asarray(pages[:n], jnp.int32)
+        kp = k.reshape(L, n, self.page_size, self.n_kv_heads, self.head_dim)
+        vp = v.reshape(L, n, self.page_size, self.n_kv_heads, self.head_dim)
+        if self.quant == "none":
+            self.pools = {
+                "k": self.pools["k"].at[:, ids].set(kp.astype(self.dtype)),
+                "v": self.pools["v"].at[:, ids].set(vp.astype(self.dtype)),
+            }
+        else:
+            qk, sk = kv_quantize(kp, self.quant)
+            qv, sv = kv_quantize(vp, self.quant)
+            self.pools = {
+                "k": self.pools["k"].at[:, ids].set(qk),
+                "v": self.pools["v"].at[:, ids].set(qv),
+                "k_scale": self.pools["k_scale"].at[:, ids].set(sk),
+                "v_scale": self.pools["v_scale"].at[:, ids].set(sv),
+            }
+
+    # -- defrag ------------------------------------------------------------
+
+    def defrag(self) -> int:
+        """Compact allocated pages onto the lowest pool indices.
+
+        Builds the old->new permutation (sequence admission order, page
+        order within each sequence), gathers the device pools through
+        it, rewrites the per-sequence page lists, and resets the free
+        heap to the tail. Returns the number of pages moved (also
+        accumulated in ``defrag_moves``). Reserved pages never move.
+        """
+        import numpy as np
+
+        perm = np.arange(self.num_pages)
+        next_id = RESERVED_PAGES
+        moves = 0
+        new_lists: Dict[int, List[int]] = {}
+        for seq_id in self._seq_pages:  # dict preserves admission order
+            new_pages = []
+            for old in self._seq_pages[seq_id]:
+                if old != next_id:
+                    moves += 1
+                perm[next_id] = old
+                new_pages.append(next_id)
+                next_id += 1
+            new_lists[seq_id] = new_pages
+        if moves:
+            # free pages fill the tail in any order; their content is
+            # junk by contract (only table-listed pages are ever read)
+            used = set(perm[:next_id])
+            tail = [p for p in range(self.num_pages) if p not in used]
+            perm[next_id:] = tail
+            idx = jnp.asarray(perm, jnp.int32)
+            self.pools = {k: p[:, idx] for k, p in self.pools.items()}
+            self._seq_pages = new_lists
+        self._free = list(range(next_id, self.num_pages))
+        heapq.heapify(self._free)
+        self.defrag_moves += moves
+        if moves:
+            self.table_version += 1
+        return moves

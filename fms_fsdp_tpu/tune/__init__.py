@@ -10,10 +10,11 @@ Three layers (docs/performance.md "Autotuning"):
   in-repo (KERNEL_TUNING.json, like AOT_LOWER.json), keyed by
   (kernel, shape signature, dtype, chip kind);
 - :mod:`lookup` — trace-time resolution wired into
-  ops/{flash_attention,ssd,fused_ce}: exact table match first, nearest
-  signature next, today's static defaults last. Pure table + cost
-  model — the lookup path never times anything, so tier-1 CPU runs are
-  fully deterministic.
+  ops/{flash_attention,ssd,fused_ce} and the serving engine's paged
+  decode (resolve_paged_decode, answered once at engine build): exact
+  table match first, nearest signature next, today's static defaults
+  last. Pure table + cost model — the lookup path never times anything,
+  so tier-1 CPU runs are fully deterministic.
 
 The on-device sweep that fills the table is scripts/autotune_kernels.py.
 """
@@ -24,6 +25,7 @@ from fms_fsdp_tpu.tune.lookup import (  # noqa: F401
     configure_kernel_tuning,
     resolve_ce_chunk,
     resolve_flash,
+    resolve_paged_decode,
     resolve_ssd_chunk,
 )
 from fms_fsdp_tpu.tune.table import (  # noqa: F401

@@ -25,6 +25,10 @@ document:
 - fused CE (ops/fused_ce.py): an XLA scan, not a Pallas kernel — the
   constraint is the fp32 (chunk, V) logits tile (one live in fwd, two in
   bwd: p and d_logits), budgeted against HBM headroom rather than VMEM.
+- paged decode (ops/paged_attention.py): per grid cell one (block_kv, H)
+  k and v page block (double-buffered), the (group, H) q/o blocks, and
+  the fp32 online-softmax scratch — O(block) residency like the kvgrid
+  family, plus the scalar-prefetched page table in SMEM.
 """
 
 from typing import Dict, List, Optional
@@ -337,16 +341,92 @@ def ce_config_legal(config: Dict, sig: Dict[str, int], dtype: str,
     return ce_working_set_bytes(sig, dtype, c) <= CE_HBM_BUDGET_BYTES
 
 
+# ---------------------------------------------------------------------------
+# paged decode (serving: ragged paged-attention, ops/paged_attention.py)
+# ---------------------------------------------------------------------------
+
+PAGED_DEFAULT_PAGE_SIZE = 64
+PAGED_DEFAULT_BLOCK_KV = 64
+
+_PAGE_SIZE_CHOICES = (16, 32, 64, 128, 256)
+
+
+def paged_decode_sig(batch: int, nq: int, nkv: int, head: int,
+                     max_seq: int) -> Dict[str, int]:
+    """Shape signature of one serving decode step: the ragged batch
+    width, head geometry, and the per-sequence cache capacity the page
+    table spans (max_pages * page_size)."""
+    return {
+        "batch": int(batch),
+        "nq": int(nq),
+        "nkv": int(nkv),
+        "head": int(head),
+        "max_seq": int(max_seq),
+    }
+
+
+def paged_decode_vmem_bytes(sig: Dict[str, int], dtype: str,
+                            page_size: int, block_kv: int) -> int:
+    """Per-core residency of one (batch, kv-head) cell of the decode
+    kernel: k+v blocks of ``block_kv`` positions (double-buffered — the
+    next page's DMA runs behind the current page's compute), the
+    (group, H) q/o blocks, the fp32 online-softmax scratch, and the
+    row's page-table slice in SMEM (4 bytes per page, counted for
+    honesty though it never threatens the budget)."""
+    db = dtype_bytes(dtype)
+    h = sig["head"]
+    group = max(1, sig["nq"] // max(1, sig["nkv"]))
+    kv = 2 * block_kv * h * db * _DB
+    q_o = 2 * group * h * db * _DB
+    scratch = group * h * 4 + 2 * group * 4  # fp32 acc + m/l
+    table = 4 * (sig["max_seq"] // max(1, page_size))
+    return kv + q_o + scratch + table
+
+
+def paged_decode_candidates(sig: Dict[str, int], dtype: str,
+                            chip: str) -> List[Dict]:
+    """Legal (page_size, block_kv) tiles under the VMEM budget. The v1
+    kernel walks one page per grid step, so enumeration keeps
+    block_kv == page_size; the cost model prices larger multi-page
+    blocks too (manual-DMA fetch, the RPA paper's layout) so a future
+    kernel can consume measured entries without a schema change."""
+    budget = vmem_budget(chip)
+    out = []
+    for ps in _PAGE_SIZE_CHOICES:
+        if ps > sig["max_seq"] or sig["max_seq"] % ps != 0:
+            continue
+        vmem = paged_decode_vmem_bytes(sig, dtype, ps, ps)
+        if vmem > budget:
+            continue
+        out.append({"page_size": ps, "block_kv": ps, "vmem_bytes": vmem})
+    return out
+
+
+def paged_decode_config_legal(config: Dict, sig: Dict[str, int], dtype: str,
+                              chip: str) -> bool:
+    ps = config.get("page_size")
+    bkv = config.get("block_kv", ps)
+    if not isinstance(ps, int) or ps <= 0:
+        return False
+    if not isinstance(bkv, int) or bkv <= 0 or bkv % ps != 0:
+        return False
+    if ps > sig["max_seq"] or sig["max_seq"] % ps != 0:
+        return False
+    return paged_decode_vmem_bytes(sig, dtype, ps, bkv) <= vmem_budget(chip)
+
+
 LEGALITY = {
     "flash_attention": flash_config_legal,
     "ssd": ssd_config_legal,
     "fused_ce": ce_config_legal,
+    "paged_decode": paged_decode_config_legal,
 }
 
 CANDIDATES = {
     "flash_attention": flash_candidates,
     "ssd": ssd_candidates,
     "fused_ce": ce_candidates,
+    "paged_decode": paged_decode_candidates,
 }
 
 

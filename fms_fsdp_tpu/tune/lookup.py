@@ -314,6 +314,52 @@ def resolve_ssd_chunk(x_shape, groups: int, dstate: int, dtype: str,
     return L
 
 
+def resolve_paged_decode(batch: int, nq: int, nkv: int, head: int,
+                         max_seq: int, dtype: str,
+                         requested_page_size: Optional[int] = None,
+                         requested_block_kv: Optional[int] = None,
+                         chip: Optional[str] = None,
+                         ) -> Tuple[int, int, str]:
+    """(page_size, block_kv, how) for the serving engine's paged decode
+    (ops/paged_attention.py). Resolved ONCE at engine build — page size
+    shapes the allocator's pool, so unlike the per-call flash blocks it
+    can never change under a live cache. Same pinning contract as
+    resolve_flash: explicitly requested values are honored (ServeConfig
+    .page_size != 0 pins), only unset pieces consult the table, and the
+    static defaults fill the gaps with tuning off — pure table +
+    cost-model work, no timing."""
+    sig = cand.paged_decode_sig(batch, nq, nkv, head, max_seq)
+    pinned = requested_page_size is not None
+    ps = requested_page_size or cand.PAGED_DEFAULT_PAGE_SIZE
+    bkv = requested_block_kv or ps
+    if pinned and max_seq % ps != 0:
+        # fail loud: silently halving an OPERATOR-pinned page size would
+        # build a different allocator than the one the config names
+        # (same contract as an unusable explicit tuning table)
+        raise ValueError(
+            f"ServeConfig.page_size={ps} does not divide "
+            f"max_seq_len={max_seq}; pick a dividing page size or leave "
+            f"it 0 for table resolution"
+        )
+    how = "pinned" if (_MODE != "off" and pinned) else "off"
+    if _MODE != "off" and not pinned:
+        config, how = _lookup("paged_decode", sig, dtype, chip)
+        if config is not None:
+            ps = int(config.get("page_size", ps))
+            bkv = int(config.get("block_kv", ps))
+    # the per-sequence capacity must stay page-aligned whatever the
+    # table or static default said (a nearest-signature hit, re-checked
+    # as it is, can still differ from this max_seq's divisors)
+    while max_seq % ps != 0 and ps > 1:
+        ps //= 2
+        bkv = ps
+    _record(
+        "paged",
+        {"page_size": ps, "block_kv": bkv, "how": how, "max_seq": max_seq},
+    )
+    return ps, bkv, how
+
+
 def resolve_ce_chunk(d_model: int, vocab: int, dtype: str,
                      requested: int, chip: Optional[str] = None) -> int:
     """Logits-chunk size for the fused lm-head+CE; ``requested`` is

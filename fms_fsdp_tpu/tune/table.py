@@ -33,7 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 TUNING_SCHEMA_VERSION = 1
 
-KNOWN_KERNELS = ("flash_attention", "ssd", "fused_ce")
+KNOWN_KERNELS = ("flash_attention", "ssd", "fused_ce", "paged_decode")
 
 _REQUIRED_ENTRY_FIELDS = ("kernel", "chip", "dtype", "signature", "config")
 

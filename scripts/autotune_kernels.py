@@ -88,6 +88,14 @@ SUITE = [
     # (d 1024, 128k vocab) the long-context rows run
     ("fused_ce", {"d_model": 4096, "vocab": 32000}, "bfloat16"),
     ("fused_ce", {"d_model": 1024, "vocab": 128256}, "bfloat16"),
+    # paged decode (serving): 7B-shaped GQA decode batch and the
+    # high-throughput small-model shape bench_serving.py drives
+    ("paged_decode",
+     {"batch": 8, "nq": 32, "nkv": 8, "head": 128, "max_seq": 4096},
+     "bfloat16"),
+    ("paged_decode",
+     {"batch": 16, "nq": 8, "nkv": 8, "head": 128, "max_seq": 2048},
+     "bfloat16"),
 ]
 
 
@@ -109,6 +117,11 @@ def _default_config(kernel: str) -> dict:
         }
     if kernel == "ssd":
         return {"chunk": cand.SSD_DEFAULT_CHUNK}
+    if kernel == "paged_decode":
+        return {
+            "page_size": cand.PAGED_DEFAULT_PAGE_SIZE,
+            "block_kv": cand.PAGED_DEFAULT_BLOCK_KV,
+        }
     return {"chunk": cand.CE_DEFAULT_CHUNK}
 
 
@@ -203,6 +216,30 @@ def _measure_child(spec_json: str):
 
         f = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
         args = (x, Bm, Cm)
+    elif kernel == "paged_decode":
+        from fms_fsdp_tpu.ops.paged_attention import paged_attention_kernel
+
+        b, nq, nkv, h, max_seq = (
+            sig["batch"], sig["nq"], sig["nkv"], sig["head"],
+            sig["max_seq"],
+        )
+        ps = config["page_size"]
+        maxp = max_seq // ps
+        # pool sized for the batch at capacity; sequential page tables
+        # with rows at ~3/4 capacity (the ragged steady state)
+        pool = b * maxp + 2
+        kp = jax.random.normal(jax.random.PRNGKey(0), (pool, ps, nkv, h), dt)
+        vp = jax.random.normal(jax.random.PRNGKey(1), (pool, ps, nkv, h), dt)
+        q = jax.random.normal(jax.random.PRNGKey(2), (b, nq, h), dt)
+        import numpy as np
+
+        table = np.arange(2, 2 + b * maxp, dtype=np.int32).reshape(b, maxp)
+        lens = np.full((b,), (3 * max_seq) // 4, np.int32)
+
+        f = jax.jit(
+            lambda q, kp, vp, t, l: paged_attention_kernel(q, kp, vp, t, l)
+        )
+        args = (q, kp, vp, jnp.asarray(table), jnp.asarray(lens))
     else:  # fused_ce
         from fms_fsdp_tpu.ops.fused_ce import fused_linear_cross_entropy
 
@@ -329,6 +366,7 @@ def main():
             configure_kernel_tuning,
             resolve_ce_chunk,
             resolve_flash,
+            resolve_paged_decode,
             resolve_ssd_chunk,
             choices,
         )
@@ -352,6 +390,12 @@ def main():
                     requested=cand.SSD_DEFAULT_CHUNK, chip=chip,
                 )
                 r = {"chunk": L, "how": choices()["ssd"]["how"]}
+            elif kernel == "paged_decode":
+                ps, bkv, how = resolve_paged_decode(
+                    sig["batch"], sig["nq"], sig["nkv"], sig["head"],
+                    sig["max_seq"], dtype, chip=chip,
+                )
+                r = {"page_size": ps, "block_kv": bkv, "how": how}
             else:
                 c = resolve_ce_chunk(
                     sig["d_model"], sig["vocab"], dtype,

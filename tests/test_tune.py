@@ -139,6 +139,13 @@ def test_committed_table_is_valid_and_serves_bench_shapes():
         "fused_ce", "v5e", "bfloat16", {"d_model": 4096, "vocab": 32000}
     )
     assert how == "exact" and config["chunk"] > 0
+    # paged decode (the 7B-shaped serving signature)
+    config, how = t.lookup(
+        "paged_decode", "v5e", "bfloat16",
+        {"batch": 8, "nq": 32, "nkv": 8, "head": 128, "max_seq": 4096},
+    )
+    assert how == "exact" and config["page_size"] > 0
+    assert config["block_kv"] % config["page_size"] == 0
 
 
 def test_measured_entry_not_clobbered_by_cost_model(tmp_path):
@@ -710,7 +717,9 @@ def test_autotune_dry_run_candidates_and_pruning():
                 for c in cands
                 if not c.get("quant")
             )
-    assert set(by_kernel) == {"flash_attention", "ssd", "fused_ce"}
+    assert set(by_kernel) == {
+        "flash_attention", "ssd", "fused_ce", "paged_decode"
+    }
 
 
 @pytest.mark.slow
