@@ -126,6 +126,21 @@ class TrainConfig:
     # amax-history window for quantized_reduce="fp8_delayed" (the
     # TransformerEngine-style delayed-scaling recipe)
     fp8_amax_history_len: int = 16
+    # Bucketed DCN-overlapped gradient reduction (docs/performance.md
+    # "Hiding the DCN", parallel/overlap.py): "auto" buckets the grad
+    # tree and anchors each bucket's cross-slice reduce inside the
+    # backward on multi-slice meshes (no-op on dcn=1 meshes — their
+    # traced step stays bit-identical); "off" skips the overlap path
+    # entirely (traces today's program bit-identically on ANY mesh);
+    # "on" forces the anchors even on single-slice meshes (debugging).
+    # Value-identical either way: the 2-slice e2e pins the final
+    # STATE_HASH bit-for-bit against the unbucketed path.
+    dcn_overlap: str = "auto"
+    # Bucket size target in MB of wire bytes. 0 = resolve through the
+    # dcn_bucket tuning entry (KERNEL_TUNING.json cost model / measured,
+    # like the kernel tiles above); nonzero pins the size, winning over
+    # the table.
+    dcn_bucket_mb: int = 0
     # Kernel autotuning (docs/performance.md "Autotuning"): "auto" reads
     # tile/block/chunk choices for flash, SSD, and fused-CE from the
     # committed per-chip tuning table (KERNEL_TUNING.json), falling back

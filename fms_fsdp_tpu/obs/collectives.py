@@ -34,15 +34,31 @@ rank calls ``Observer.report`` at the same step (non-zero ranks run it
 sink-less for exactly this kind of rank-consistent timing).
 """
 
-from typing import Callable, Optional
+from functools import partial
+from typing import Callable, Dict, Optional
 
 from fms_fsdp_tpu.parallel.mesh import AXIS_DCN, DATA_AXES, num_mesh_slices
 
 
-def make_collective_split_probe(mesh, timer) -> Optional[Callable[[], None]]:
+def make_collective_split_probe(
+    mesh, timer, schedule: Optional[Dict] = None
+) -> Optional[Callable[[], None]]:
     """Build the probe for ``mesh``, recording into ``timer``'s
     ``ici_collective`` / ``dcn_collective`` phases. None on single-slice
-    meshes (the fields then stay 0.0 and no probe program exists)."""
+    meshes (the fields then stay 0.0 and no probe program exists).
+
+    ``schedule`` is the resolved DCN-overlap bucket summary
+    (parallel/overlap.py ``plan_summary()``). Without one the DCN probe
+    is the historical tiny-payload latency ping. With one, the probe
+    replays the step's REAL reduce schedule: one cross-slice all-reduce
+    per bucket whose wire payload matches that bucket's wire bytes —
+    so ``dcn_collective_s`` prices what the step actually puts on the
+    DCN each backward (bytes/bandwidth + per-bucket latency), not a
+    fixed toy ping, and the overlap estimate (Observer's
+    ``dcn_overlap_frac``) divides time that corresponds to the schedule
+    it reasons about. Probe arrays are fp32 and deduplicated by bucket
+    size, so host memory is ~one bucket per distinct size, not the
+    whole gradient."""
     if mesh is None or num_mesh_slices(mesh) <= 1:
         return None
 
@@ -72,13 +88,37 @@ def make_collective_split_probe(mesh, timer) -> Optional[Callable[[], None]]:
         )
         return fn, x
 
-    dcn_fn, dcn_x = _probe_pair((AXIS_DCN,))
+    def _bucket_pair(nbytes):
+        """(jitted fn, input) reducing a (slices, n)-sharded array over
+        the dcn axis to a replicated (n,) vector: GSPMD inserts one
+        cross-slice all-reduce that moves ~``nbytes`` on the wire (fp32
+        elements sized to the bucket's wire bytes)."""
+        extent = int(mesh.shape[AXIS_DCN])
+        n = max(1, int(nbytes) // 4)
+        sharding = NamedSharding(mesh, P(AXIS_DCN))
+        x = jax.make_array_from_callback(
+            (extent, n),
+            sharding,
+            lambda idx: np.ones((extent, n), np.float32)[idx],
+        )
+        fn = jax.jit(
+            partial(jnp.sum, axis=0), out_shardings=NamedSharding(mesh, P())
+        )
+        return fn, x
+
+    bucket_bytes = list((schedule or {}).get("bytes_per_bucket", []) or [])
+    if bucket_bytes:
+        by_size = {int(b): _bucket_pair(b) for b in sorted(set(bucket_bytes))}
+        dcn_probes = [by_size[int(b)] for b in bucket_bytes]
+    else:
+        dcn_probes = [_probe_pair((AXIS_DCN,))]
     ici = _probe_pair(ici_axes) if ici_axes else None
-    # warm both programs OUTSIDE the timed phases: the first report
+    # warm every program OUTSIDE the timed phases: the first report
     # window must measure reduce latency, not XLA compile time — a
     # compile-polluted first dcn_collective_s is exactly the "degrading
     # DCN link" signature operators are told to triage on
-    dcn_fn(dcn_x).block_until_ready()
+    for fn, x in dcn_probes:
+        fn(x).block_until_ready()
     if ici is not None:
         ici[0](ici[1]).block_until_ready()
 
@@ -87,6 +127,7 @@ def make_collective_split_probe(mesh, timer) -> Optional[Callable[[], None]]:
             with timer.phase("ici_collective"):
                 ici[0](ici[1]).block_until_ready()
         with timer.phase("dcn_collective"):
-            dcn_fn(dcn_x).block_until_ready()
+            for fn, x in dcn_probes:
+                fn(x).block_until_ready()
 
     return probe

@@ -90,6 +90,11 @@ class Observer:
         # window for the v8 integrity_verify_s / scrub_verified /
         # divergence_checks fields; absent -> 0 / 0 / 0.0
         self._integrity_stats: Optional[Callable[[], Dict]] = None
+        # set by the entry when the step was built with the DCN-overlap
+        # schedule (parallel/overlap.py plan_summary()): bucket count +
+        # bytes, consumed by the v10 dcn_overlap_frac estimate; None
+        # (overlap off / single-slice) keeps the field 0.0
+        self._overlap_schedule: Optional[Dict] = None
 
     def attach_checkpoint_stats(self, fn: Callable[[], Dict]) -> None:
         self._ckpt_stats = fn
@@ -99,6 +104,31 @@ class Observer:
 
     def attach_collective_probe(self, fn: Optional[Callable[[], None]]) -> None:
         self._collective_probe = fn
+
+    def attach_overlap_schedule(self, schedule: Optional[Dict]) -> None:
+        self._overlap_schedule = dict(schedule) if schedule else None
+
+    def _overlap_frac(self, window: Dict) -> float:
+        """Estimate the fraction of the window's DCN collective time the
+        bucket schedule hides under backward compute.
+
+        With K buckets, only the first bucket's reduce has nothing to
+        overlap with (the backward for later buckets runs under it), so
+        the structurally exposed time is ~d/K plus whatever total DCN
+        time exceeds the backward compute available to hide it (taken as
+        2/3 of the window's compute — backward's share of fwd+bwd).
+        Clamped to [0, 1]; 0.0 without a schedule or probe signal. An
+        estimate for trend lines, not a bytes-accurate profile — the
+        XPlane profiler owns exactness."""
+        if not self._overlap_schedule:
+            return 0.0
+        d = float(window.get("dcn_collective", 0.0))
+        if d <= 0.0:
+            return 0.0
+        k = max(1, int(self._overlap_schedule.get("buckets", 1)))
+        c = float(window.get("compute", 0.0)) * (2.0 / 3.0)
+        exposed = d / k + max(0.0, d - d / k - c)
+        return max(0.0, min(1.0, 1.0 - exposed / d))
 
     # -- hot-loop hooks ----------------------------------------------------
 
@@ -212,6 +242,9 @@ class Observer:
             # probe; 0.0 without one — single-slice runs)
             "ici_collective_s": window.get("ici_collective", 0.0),
             "dcn_collective_s": window.get("dcn_collective", 0.0),
+            # v10: estimated hidden fraction of the DCN time above under
+            # the bucketed overlap schedule (0.0 when overlap is off)
+            "dcn_overlap_frac": self._overlap_frac(window),
             # v8: state-integrity accounting (scrub + divergence layer;
             # 0 / 0 / 0.0 when the layer is not armed)
             "integrity_verify_s": float(integ.get("verify_s", 0.0)),

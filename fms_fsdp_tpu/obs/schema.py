@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -60,6 +60,13 @@ SCHEMA_FIELDS = {
     # (no probe is traced; the train step's HLO stays untouched).
     "ici_collective_s": ("float", True),
     "dcn_collective_s": ("float", True),
+    # v10: estimated fraction of the window's DCN collective time hidden
+    # under backward compute by the bucketed overlap schedule
+    # (parallel/overlap.py; docs/observability.md "DCN overlap"). Derived
+    # from the probe's dcn_collective_s, the resolved bucket count, and
+    # the window's compute time — 0.0 when overlap is off, the mesh is
+    # single-slice, or no probe ran this window.
+    "dcn_overlap_frac": ("float", True),
     "wall_s": ("float", True),
     "goodput": ("float", True),
     "goodput_overall": ("float", False),
@@ -168,6 +175,10 @@ SCHEMA_DIGESTS = {
     # queue_depth, kv_pages_in_use, request outcome counts,
     # p99_latency_s — docs/serving.md)
     9: "178c0ec2d1d31834a0ae939d0df6e734ce66665f0dfccb662ab97dcc5fcc4e12",
+    # v10: + dcn_overlap_frac (estimated hidden fraction of the window's
+    # DCN collective time under the bucketed overlap schedule —
+    # parallel/overlap.py, docs/observability.md "DCN overlap")
+    10: "864cdd64b4d6f3fa3dd7e24c3e0a18f42ae118f56965c32fbfb2f0a847f7287a",
 }
 
 

@@ -431,3 +431,67 @@ def hlo_collective_split(hlo_text: str, mesh: Mesh) -> dict:
         )
         counts["dcn" if crosses else "ici"] += 1
     return counts
+
+
+_BACKWARD_MARKERS = ("transpose(", "fwd_bwd")
+
+
+def hlo_collective_schedule(hlo_text: str, mesh: Mesh) -> dict:
+    """Structural view of WHERE the collectives sit in the compiled
+    program, not just how many there are (hlo_collective_split).
+
+    Walks the HLO text in emission order and classifies each line as a
+    collective (ici/dcn, same replica-group attribution as the split) or
+    a backward-compute op (op_name metadata under the ``fwd_bwd`` scope
+    or a ``transpose(...)`` autodiff region). Returns::
+
+        {"dcn": K, "ici": N, "backward_lines": B,
+         "interleaved_pairs": P}
+
+    ``interleaved_pairs`` counts consecutive pairs of dcn collectives
+    with at least one backward-compute op strictly between them — the
+    property the DCN-overlap schedule exists to create (a program whose
+    cross-slice reduces all sit in one tail blob scores 0; one whose
+    reduces are threaded through the backward scores K-1). Collective
+    lines themselves never count as backward markers even when their
+    op_name carries a transpose scope, so a blob of back-to-back grad
+    reduces cannot self-certify as interleaved."""
+    n_slices = int(mesh.shape.get(AXIS_DCN, 1))
+    per_slice = max(1, mesh.size // max(1, n_slices))
+    slice_of = {i: i // per_slice for i in range(mesh.size)}
+    op_re = re.compile(
+        r"\b(" + "|".join(_COLLECTIVE_OPS) + r")(-start)?(\.\d+)?\("
+    )
+    events = []  # ("dcn" | "ici" | "bwd") in program order
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if op_re.search(stripped) and "-done" not in stripped:
+            groups = _parse_replica_groups(stripped)
+            if groups is None:
+                continue
+            crosses = any(
+                len({slice_of.get(i, -1) for i in g}) > 1 for g in groups
+            )
+            events.append("dcn" if crosses else "ici")
+            continue
+        if "op_name=" in stripped and any(
+            m in stripped for m in _BACKWARD_MARKERS
+        ):
+            events.append("bwd")
+    out = {
+        "dcn": events.count("dcn"),
+        "ici": events.count("ici"),
+        "backward_lines": events.count("bwd"),
+        "interleaved_pairs": 0,
+    }
+    saw_bwd_since_dcn = False
+    saw_dcn = False
+    for ev in events:
+        if ev == "dcn":
+            if saw_dcn and saw_bwd_since_dcn:
+                out["interleaved_pairs"] += 1
+            saw_dcn = True
+            saw_bwd_since_dcn = False
+        elif ev == "bwd":
+            saw_bwd_since_dcn = True
+    return out

@@ -230,13 +230,14 @@ def make_train_step(
     from fms_fsdp_tpu.tune.lookup import (
         configure_kernel_tuning,
         resolve_ce_chunk,
+        resolve_dcn_bucket,
     )
 
     configure_kernel_tuning(
         getattr(cfg, "kernel_tuning", None),
         getattr(cfg, "kernel_tuning_table", "") or None,
     )
-    _, forward_fn, _, n_layers = get_model_api(model_cfg)
+    init_params, forward_fn, specs_fn, n_layers = get_model_api(model_cfg)
     ac_mask = None
     if cfg.fsdp_activation_checkpointing:
         ac_mask = selective_ac_mask(n_layers, cfg.selective_checkpointing)
@@ -291,7 +292,45 @@ def make_train_step(
         # drop_frac is reported as a metric.
         extra_kwargs = {"moe_impl": "dispatch", "return_aux": True}
 
+    # DCN overlap (parallel/overlap.py): resolve the bucket schedule once
+    # per step build — same discipline as the flash variant and the tuning
+    # table above. When disabled ("off", or "auto" on a single-slice
+    # mesh), bucket_plan stays None and every branch below is the
+    # pre-overlap code path, so the traced program is bit-identical to
+    # the unbucketed step (pinned by tests/test_overlap.py).
+    from fms_fsdp_tpu.parallel import overlap as dcn_overlap
+    from fms_fsdp_tpu.parallel.mesh import num_mesh_slices
+
+    bucket_plan = None
+    param_specs = None
+    dcn_overlap.set_plan_summary(None)
+    if dcn_overlap.overlap_enabled(getattr(cfg, "dcn_overlap", "auto"), mesh):
+        param_shapes = jax.eval_shape(
+            lambda k: init_params(k, model_cfg, dtype=policy.param_dtype),
+            jax.random.PRNGKey(0),
+        )
+        wire = dcn_overlap.wire_bytes_per_element(policy.reduce_quant)
+        shape_leaves = jax.tree.leaves(param_shapes)
+        total_wire = sum(int(s.size) for s in shape_leaves) * wire
+        bucket_mb = resolve_dcn_bucket(
+            grad_mb=-(-total_wire // dcn_overlap.MB),
+            leaves=len(shape_leaves),
+            slices=num_mesh_slices(mesh),
+            wire_bytes=wire,
+            requested=int(getattr(cfg, "dcn_bucket_mb", 0)),
+        )
+        bucket_plan = dcn_overlap.assign_buckets(param_shapes, bucket_mb, wire)
+        param_specs = specs_fn()
+        dcn_overlap.set_plan_summary(bucket_plan.summary())
+
     def loss_fn(params, inputs, labels):
+        if bucket_plan is not None:
+            # bucket anchors go around the params *entering* the forward,
+            # so each bucket's cotangents join the backward exactly where
+            # that bucket's layers finish differentiating
+            params = dcn_overlap.apply_bucket_anchors(
+                params, bucket_plan, param_specs, mesh
+            )
         out = forward_fn(
             params,
             inputs,
@@ -380,9 +419,14 @@ def make_train_step(
         new_quant = state.get("quant")
         if policy.reduce_quant != "none":
             with jax.named_scope("quant_reduce"):
-                grads, new_quant = quantized_grad_reduce(
-                    grads, policy.reduce_quant, new_quant
-                )
+                if bucket_plan is not None:
+                    grads, new_quant = dcn_overlap.bucketed_quantized_grad_reduce(
+                        grads, policy.reduce_quant, new_quant, bucket_plan
+                    )
+                else:
+                    grads, new_quant = quantized_grad_reduce(
+                        grads, policy.reduce_quant, new_quant
+                    )
         clip_scale = jnp.minimum(1.0, cfg.grad_clip_thresh / (gnorm + 1e-6))
         if guard_updates:
             # zero poisoned grads with a true select — scaling by 0 would

@@ -415,11 +415,93 @@ def paged_decode_config_legal(config: Dict, sig: Dict[str, int], dtype: str,
     return paged_decode_vmem_bytes(sig, dtype, ps, bkv) <= vmem_budget(chip)
 
 
+# ---------------------------------------------------------------------------
+# dcn_bucket (bucketed cross-slice gradient reduction, parallel/overlap.py)
+# ---------------------------------------------------------------------------
+
+DCN_BUCKET_DEFAULT_MB = 32
+
+_DCN_BUCKET_MB_CHOICES = (4, 8, 16, 32, 64, 128)
+
+# Per-chip-pair DCN characteristics for the bytes-on-wire cost model:
+# effective per-chip cross-slice bandwidth (bytes/s) and the per-collective
+# launch/rendezvous latency. Working figures from the multi-slice scaling
+# guidance the dcn axis was sized against; chips we have not measured
+# inherit the conservative default.
+CHIP_DCN_BANDWIDTH: Dict[str, float] = {
+    "v4": 25e9,
+    "v5e": 12.5e9,
+    "v5p": 50e9,
+    "v6e": 25e9,
+}
+DEFAULT_DCN_BANDWIDTH = 12.5e9
+DCN_COLLECTIVE_LATENCY_S = 50e-6
+
+
+def dcn_bucket_sig(grad_mb: int, leaves: int, slices: int,
+                   wire_bytes: int) -> Dict[str, int]:
+    """Signature of one gradient-reduction schedule: total wire MB of
+    the grad tree (rounded up), its leaf count, the slice count, and the
+    wire width (1 for the fp8/int8 reduce formats, 2 for bf16)."""
+    return {
+        "grad_mb": max(1, int(grad_mb)),
+        "leaves": int(leaves),
+        "slices": int(slices),
+        "wire_bytes": int(wire_bytes),
+    }
+
+
+def dcn_bucket_cost_s(sig: Dict[str, int], bucket_mb: int,
+                      chip: str) -> float:
+    """Exposed-latency estimate for one bucket size: K buckets pay K
+    collective launches, and the LAST bucket's wire time cannot hide
+    under any remaining backward compute (2x for the ring all-reduce's
+    reduce+broadcast halves across slices). Minimizing trades launch
+    count (favors big buckets) against the exposed tail (favors small
+    ones)."""
+    bw = CHIP_DCN_BANDWIDTH.get(chip, DEFAULT_DCN_BANDWIDTH)
+    total = sig["grad_mb"] << 20
+    bucket = max(1, int(bucket_mb)) << 20
+    k = max(1, -(-total // bucket))  # ceil
+    tail_bytes = min(bucket, total)
+    hops = 2 * (sig["slices"] - 1) / max(1, sig["slices"])
+    return k * DCN_COLLECTIVE_LATENCY_S + tail_bytes * hops / bw
+
+
+def dcn_bucket_candidates(sig: Dict[str, int], dtype: str,
+                          chip: str) -> List[Dict]:
+    """Legal bucket sizes with their modeled exposed cost. A candidate
+    larger than the grad tree collapses to one bucket — legal (it is
+    exactly the unsplit schedule) but only the smallest such size is
+    kept, so the sweep never times duplicates."""
+    del dtype  # the wire width is part of the signature
+    out = []
+    seen_single = False
+    for mb in _DCN_BUCKET_MB_CHOICES:
+        if mb >= sig["grad_mb"]:
+            if seen_single:
+                continue
+            seen_single = True
+        out.append({
+            "bucket_mb": mb,
+            "cost_us": round(dcn_bucket_cost_s(sig, mb, chip) * 1e6, 3),
+        })
+    return out
+
+
+def dcn_bucket_config_legal(config: Dict, sig: Dict[str, int], dtype: str,
+                            chip: str) -> bool:
+    del sig, dtype, chip  # any positive size buckets any tree
+    mb = config.get("bucket_mb")
+    return isinstance(mb, int) and not isinstance(mb, bool) and mb > 0
+
+
 LEGALITY = {
     "flash_attention": flash_config_legal,
     "ssd": ssd_config_legal,
     "fused_ce": ce_config_legal,
     "paged_decode": paged_decode_config_legal,
+    "dcn_bucket": dcn_bucket_config_legal,
 }
 
 CANDIDATES = {
@@ -427,6 +509,7 @@ CANDIDATES = {
     "ssd": ssd_candidates,
     "fused_ce": ce_candidates,
     "paged_decode": paged_decode_candidates,
+    "dcn_bucket": dcn_bucket_candidates,
 }
 
 

@@ -380,6 +380,43 @@ def resolve_ce_chunk(d_model: int, vocab: int, dtype: str,
     return c
 
 
+def resolve_dcn_bucket(grad_mb: int, leaves: int, slices: int,
+                       wire_bytes: int, requested: int = 0,
+                       chip: Optional[str] = None) -> int:
+    """Bucket size (MB of wire bytes) for the overlapped DCN gradient
+    reduction (parallel/overlap.py), resolved once per step build.
+
+    Same pinning contract as resolve_ce_chunk: ``requested`` is
+    TrainConfig.dcn_bucket_mb — nonzero is an explicit operator choice
+    and wins over the table; 0 consults the table (exact -> nearest ->
+    the cost model's pick over the candidate sizes, so even a tableless
+    host gets a bytes-on-wire/DCN-bandwidth-reasoned size rather than a
+    blind constant)."""
+    sig = cand.dcn_bucket_sig(grad_mb, leaves, slices, wire_bytes)
+    pinned = int(requested) != 0
+    mb, how = int(requested) or cand.DCN_BUCKET_DEFAULT_MB, "off"
+    chip_key = chip or chip_kind()
+    if _MODE != "off":
+        if pinned:
+            how = "pinned"
+        else:
+            config, how = _lookup("dcn_bucket", sig, "bfloat16", chip)
+            if config is not None:
+                mb = int(config["bucket_mb"])
+            else:
+                # cost-model fallback: cheapest modeled exposed latency
+                # among the candidate sizes (pure host arithmetic)
+                cands = cand.dcn_bucket_candidates(sig, "bfloat16", chip_key)
+                if cands:
+                    mb = min(cands, key=lambda c: c["cost_us"])["bucket_mb"]
+    _record(
+        "dcn_bucket",
+        {"bucket_mb": mb, "how": how, "grad_mb": sig["grad_mb"],
+         "slices": sig["slices"], "wire_bytes": sig["wire_bytes"]},
+    )
+    return mb
+
+
 # ---------------------------------------------------------------------------
 # degradation signal for _pick_block (ops/flash_attention.py)
 # ---------------------------------------------------------------------------

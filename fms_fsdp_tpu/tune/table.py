@@ -33,7 +33,9 @@ from typing import Dict, List, Optional, Tuple
 
 TUNING_SCHEMA_VERSION = 1
 
-KNOWN_KERNELS = ("flash_attention", "ssd", "fused_ce", "paged_decode")
+KNOWN_KERNELS = (
+    "flash_attention", "ssd", "fused_ce", "paged_decode", "dcn_bucket"
+)
 
 _REQUIRED_ENTRY_FIELDS = ("kernel", "chip", "dtype", "signature", "config")
 

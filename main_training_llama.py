@@ -159,12 +159,20 @@ def main(**kwargs):
     # multi-slice collective split (schema v5): the report-cadence probe
     # times one within-slice (ICI) and one cross-slice (DCN) reduce per
     # window so cross-slice overhead is attributable; None (and zero
-    # cost) on single-slice meshes
+    # cost) on single-slice meshes. When the step above resolved a DCN
+    # overlap schedule (parallel/overlap.py), the probe replays it — one
+    # reduce per bucket at real wire bytes — and the observer derives
+    # the v10 dcn_overlap_frac from the same schedule.
     from fms_fsdp_tpu.obs.collectives import make_collective_split_probe
+    from fms_fsdp_tpu.parallel.overlap import plan_summary
 
+    overlap_schedule = plan_summary()
     observer.attach_collective_probe(
-        make_collective_split_probe(mesh, observer.timer)
+        make_collective_split_probe(
+            mesh, observer.timer, schedule=overlap_schedule
+        )
     )
+    observer.attach_overlap_schedule(overlap_schedule)
 
     # batch loop: stack per-rank batches to the local device batch
     feed = DeviceFeed(

@@ -96,6 +96,20 @@ SUITE = [
     ("paged_decode",
      {"batch": 16, "nq": 8, "nkv": 8, "head": 128, "max_seq": 2048},
      "bfloat16"),
+    # dcn_bucket (parallel/overlap.py): the bucketed cross-slice gradient
+    # reduction schedule. grad_mb = the grad tree's total wire MB —
+    # 7B at bf16 wire (~13.4GB), the 194m-shaped model (~372MB), and the
+    # 7B again on a 4-slice world at the 1-byte fp8 wire. leaves matches
+    # the scan-stacked llama param tree (11 top-level leaves).
+    ("dcn_bucket",
+     {"grad_mb": 13344, "leaves": 11, "slices": 2, "wire_bytes": 2},
+     "bfloat16"),
+    ("dcn_bucket",
+     {"grad_mb": 372, "leaves": 11, "slices": 2, "wire_bytes": 2},
+     "bfloat16"),
+    ("dcn_bucket",
+     {"grad_mb": 6672, "leaves": 11, "slices": 4, "wire_bytes": 1},
+     "bfloat16"),
 ]
 
 
@@ -122,6 +136,8 @@ def _default_config(kernel: str) -> dict:
             "page_size": cand.PAGED_DEFAULT_PAGE_SIZE,
             "block_kv": cand.PAGED_DEFAULT_BLOCK_KV,
         }
+    if kernel == "dcn_bucket":
+        return {"bucket_mb": cand.DCN_BUCKET_DEFAULT_MB}
     return {"chunk": cand.CE_DEFAULT_CHUNK}
 
 
@@ -130,7 +146,14 @@ def _cost_model_pick(kernel: str, sig: dict, cands: list, dtype: str,
     """Chipless seed: prefer the static default when it survived
     pruning (it is the measured-in-anger configuration the shipped
     kernels were sized around), else the largest legal tile — bigger
-    tiles amortize more loop overhead per DMA under the budget."""
+    tiles amortize more loop overhead per DMA under the budget.
+    dcn_bucket candidates carry a modeled exposed-latency cost instead
+    of a VMEM footprint, so there the cheapest candidate wins."""
+    if kernel == "dcn_bucket":
+        if not cands:
+            return _default_config(kernel)
+        best = min(cands, key=lambda c: c.get("cost_us", float("inf")))
+        return _strip(best)
     default = _default_config(kernel)
     for c in cands:
         if all(c.get(k) == v for k, v in default.items() if k != "family"):
@@ -240,6 +263,39 @@ def _measure_child(spec_json: str):
             lambda q, kp, vp, t, l: paged_attention_kernel(q, kp, vp, t, l)
         )
         args = (q, kp, vp, jnp.asarray(table), jnp.asarray(lens))
+    elif kernel == "dcn_bucket":
+        # time the SCHEDULE, not a kernel: K sequential bucket-sized
+        # all-reduces over every attached device (on a multi-slice host
+        # that path crosses the DCN; single-slice sweeps measure the
+        # interconnect they have). Payload per reduce = one bucket's
+        # wire bytes in fp32 elements, K = ceil(grad_mb / bucket_mb) —
+        # the same arithmetic the cost model prices.
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = np.array(jax.devices())
+        mesh = Mesh(devs, ("x",))
+        bucket_mb = int(config["bucket_mb"])
+        total_mb = int(sig["grad_mb"])
+        k_buckets = max(1, -(-total_mb // bucket_mb))
+        nbytes = min(bucket_mb, total_mb) * 1024 * 1024
+        n = max(1, nbytes // 4)
+        x = jax.device_put(
+            jnp.ones((len(devs), n), jnp.float32),
+            NamedSharding(mesh, P("x")),
+        )
+        reduce_fn = jax.jit(
+            lambda a: jnp.sum(a, axis=0),
+            out_shardings=NamedSharding(mesh, P()),
+        )
+
+        def f(a, _k=k_buckets):
+            out = None
+            for _ in range(_k):
+                out = reduce_fn(a)
+            return out
+
+        args = (x,)
     else:  # fused_ce
         from fms_fsdp_tpu.ops.fused_ce import fused_linear_cross_entropy
 
@@ -317,7 +373,7 @@ def _time_candidate(kernel, sig, dtype, config):
 
 def _strip(config: dict) -> dict:
     return {k: v for k, v in config.items()
-            if k not in ("vmem_bytes", "working_set_bytes")}
+            if k not in ("vmem_bytes", "working_set_bytes", "cost_us")}
 
 
 def main():
@@ -365,6 +421,7 @@ def main():
         from fms_fsdp_tpu.tune.lookup import (
             configure_kernel_tuning,
             resolve_ce_chunk,
+            resolve_dcn_bucket,
             resolve_flash,
             resolve_paged_decode,
             resolve_ssd_chunk,
@@ -396,6 +453,13 @@ def main():
                     sig["max_seq"], dtype, chip=chip,
                 )
                 r = {"page_size": ps, "block_kv": bkv, "how": how}
+            elif kernel == "dcn_bucket":
+                mb = resolve_dcn_bucket(
+                    sig["grad_mb"], sig["leaves"], sig["slices"],
+                    sig["wire_bytes"], requested=0, chip=chip,
+                )
+                r = {"bucket_mb": mb,
+                     "how": choices()["dcn_bucket"]["how"]}
             else:
                 c = resolve_ce_chunk(
                     sig["d_model"], sig["vocab"], dtype,

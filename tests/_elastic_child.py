@@ -259,9 +259,17 @@ def run(ckpt_dir, data_path, walk_dir, phase, num_steps, ckpt_interval,
         from fms_fsdp_tpu.obs.collectives import make_collective_split_probe
 
         observer = build_observer(cfg, rank, model_cfg=model_cfg)
+        # replay the step's resolved DCN bucket schedule (if any) in the
+        # probe and feed the same schedule to the v10 overlap estimate
+        from fms_fsdp_tpu.parallel.overlap import plan_summary
+
+        overlap_schedule = plan_summary()
         observer.attach_collective_probe(
-            make_collective_split_probe(mesh, observer.timer)
+            make_collective_split_probe(
+                mesh, observer.timer, schedule=overlap_schedule
+            )
         )
+        observer.attach_overlap_schedule(overlap_schedule)
         train(
             cfg,
             state,

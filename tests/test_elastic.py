@@ -944,7 +944,11 @@ def test_multislice_slice_loss_resume(tmp_path):
     assert _grab(outs[3], "SLICE_CTX") == "2 1", outs[3][-2000:]
     with open(os.path.join(obs_save, "metrics.jsonl")) as f:
         recs = [json.loads(line) for line in f]
-    assert recs and all(r["schema_version"] == 7 for r in recs), recs
+    from fms_fsdp_tpu.obs.schema import SCHEMA_VERSION
+
+    assert recs and all(
+        r["schema_version"] == SCHEMA_VERSION for r in recs
+    ), recs
     assert any(r["dcn_collective_s"] > 0 for r in recs), recs
     assert any(r["ici_collective_s"] > 0 for r in recs), recs
 

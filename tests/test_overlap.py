@@ -1,0 +1,446 @@
+"""DCN-overlap schedule tests (parallel/overlap.py + train/step.py).
+
+Pins the three contracts the bucketed cross-slice gradient reduction
+must hold:
+
+- **off is free**: on a dcn=1 mesh (or ``dcn_overlap=off``) the traced
+  step is bit-identical to the unbucketed program — same compiled text,
+  zero dcn collectives;
+- **on is value-identical**: a 2-slice mesh trained with the anchored
+  schedule produces bit-for-bit the same losses and final state as the
+  unbucketed path (the in-process twin of the gloo e2e below);
+- **on is actually scheduled**: the compiled 2-slice overlap-on program
+  carries the ``dcn_bucket_reduce_<i>`` anchor scopes and >= 2 dcn
+  collectives threaded through backward compute
+  (mesh.py::hlo_collective_schedule), not one tail blob.
+"""
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from fms_fsdp_tpu.config import TrainConfig
+from fms_fsdp_tpu.models.configs import LlamaConfig
+from fms_fsdp_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    hlo_collective_schedule,
+    hlo_collective_split,
+)
+from fms_fsdp_tpu.parallel.overlap import (
+    MB,
+    BucketPlan,
+    assign_buckets,
+    bucketed_quantized_grad_reduce,
+    overlap_enabled,
+    plan_summary,
+    set_plan_summary,
+    wire_bytes_per_element,
+)
+from fms_fsdp_tpu.parallel.sharding import (
+    init_amax_state,
+    quant_leaf_key,
+    quantized_grad_reduce,
+)
+from fms_fsdp_tpu.train.step import (
+    init_train_state,
+    make_optimizer,
+    make_train_step,
+)
+
+TINY = LlamaConfig(
+    src_vocab_size=256,
+    emb_dim=64,
+    nheads=4,
+    kvheads=2,
+    nlayers=2,
+    multiple_of=16,
+    max_expected_seq_len=64,
+)
+
+# ~1.7M params -> ~3.4MB of bf16 wire bytes: splits into several 1MB
+# buckets, which TINY (250KB of grads, under the 1MB bucket floor)
+# structurally cannot
+BIGGER = LlamaConfig(
+    src_vocab_size=512,
+    emb_dim=256,
+    nheads=4,
+    kvheads=2,
+    nlayers=2,
+    multiple_of=16,
+    max_expected_seq_len=64,
+)
+
+
+def _cfg(**kw):
+    base = dict(
+        model_variant="tiny",
+        seq_length=16,
+        batch_size=2,
+        num_steps=100,
+        learning_rate=1e-2,
+        report_interval=10,
+        vocab_size=256,
+        attention_kernel="xla",
+        sharding_strategy="fsdp",
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _param_shapes(model_cfg):
+    from fms_fsdp_tpu.models.llama import init_llama_params
+
+    return jax.eval_shape(
+        lambda k: init_llama_params(k, model_cfg, dtype=jnp.bfloat16),
+        jax.random.PRNGKey(0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# bucket assignment
+# ---------------------------------------------------------------------------
+
+
+def test_assign_buckets_deterministic_and_covering():
+    shapes = _param_shapes(TINY)
+    plan_a = assign_buckets(shapes, 4, 2)
+    plan_b = assign_buckets(shapes, 4, 2)
+    assert plan_a == plan_b, "same tree + knobs must give the same plan"
+
+    flat = jax.tree_util.tree_flatten_with_path(shapes)[0]
+    all_keys = {quant_leaf_key(p) for p, _ in flat}
+    planned = [k for b in plan_a.buckets for k in b]
+    assert sorted(planned) == sorted(all_keys), "every leaf in one bucket"
+    assert len(planned) == len(set(planned)), "no leaf in two buckets"
+    assert plan_a.total_bytes == sum(
+        int(leaf.size) * 2 for _, leaf in flat
+    )
+    assert plan_a.total_bytes == sum(plan_a.bucket_bytes)
+    # the assignment is a function of leaf names + sizes only: the quant
+    # state riding in a train state must not shift it
+    with_quant = dict(shapes)
+    plan_q = assign_buckets(with_quant, 4, 2)
+    assert plan_q.buckets == plan_a.buckets
+
+    # a bucket only exceeds the target when a single leaf does
+    wide = assign_buckets(shapes, 1, 2)  # 1MB target over 250KB of grads
+    for bucket, nbytes in zip(wide.buckets, wide.bucket_bytes):
+        assert nbytes <= MB or len(bucket) == 1
+
+    s = plan_a.summary()
+    assert s["buckets"] == len(plan_a.buckets)
+    assert s["bytes_per_bucket"] == list(plan_a.bucket_bytes)
+    assert s["wire_bytes"] == 2 and s["target_mb"] == 4
+
+
+def test_assign_buckets_splits_bigger_model():
+    shapes = _param_shapes(BIGGER)
+    plan = assign_buckets(shapes, 1, wire_bytes_per_element("none"))
+    assert len(plan.buckets) >= 3, plan.summary()
+    assert plan.total_bytes > 2 * MB
+
+
+def test_wire_bytes_per_element():
+    assert wire_bytes_per_element("int8") == 1
+    assert wire_bytes_per_element("fp8") == 1
+    assert wire_bytes_per_element("fp8_delayed") == 1
+    assert wire_bytes_per_element("none") == 2
+
+
+def test_overlap_enabled_modes():
+    m1 = build_mesh(MeshConfig(sharding_strategy="fsdp"))
+    m2 = build_mesh(
+        MeshConfig.from_train_config(_cfg(num_slices=2))
+    )
+    assert not overlap_enabled("off", m1)
+    assert not overlap_enabled("off", m2)
+    assert overlap_enabled("on", m1)
+    assert overlap_enabled("on", m2)
+    assert not overlap_enabled("auto", m1)
+    assert overlap_enabled("auto", m2)
+    with pytest.raises(ValueError, match="dcn_overlap"):
+        overlap_enabled("bogus", m1)
+
+
+def test_plan_summary_registry_roundtrip():
+    try:
+        set_plan_summary({"buckets": 3, "bytes_per_bucket": [1, 2, 3]})
+        got = plan_summary()
+        assert got == {"buckets": 3, "bytes_per_bucket": [1, 2, 3]}
+        got["buckets"] = 99  # a copy, not the registry
+        assert plan_summary()["buckets"] == 3
+        set_plan_summary(None)
+        assert plan_summary() is None
+    finally:
+        set_plan_summary(None)
+
+
+# ---------------------------------------------------------------------------
+# quantized reduce composition
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8", "fp8_delayed"])
+def test_bucketed_quant_reduce_matches_plain(mode):
+    rng = np.random.default_rng(0)
+    grads = {
+        "a": jnp.asarray(rng.normal(size=(17, 64)), jnp.float32),
+        "b": jnp.asarray(rng.normal(size=(3,)), jnp.float32),
+        "c": jnp.asarray(rng.normal(size=(128,)) * 5.0, jnp.float32),
+    }
+    quant = (
+        init_amax_state(grads, 4) if mode == "fp8_delayed" else None
+    )
+    if quant is not None:
+        # non-trivial histories so delayed_scale has real state to read
+        quant = {
+            "amax_history": {
+                k: v + 0.25 * (i + 1)
+                for i, (k, v) in enumerate(
+                    sorted(quant["amax_history"].items())
+                )
+            }
+        }
+    # a hand-built multi-bucket plan (tiny leaves can't split past the
+    # 1MB floor via assign_buckets): parity must hold per-leaf however
+    # the leaves are grouped
+    keys = sorted(quant_leaf_key(p) for p, _ in
+                  jax.tree_util.tree_flatten_with_path(grads)[0])
+    plan = BucketPlan(
+        buckets=(tuple(keys[:1]), tuple(keys[1:])),
+        bucket_bytes=(0, 0),
+        target_mb=1,
+        wire_bytes=1,
+        total_bytes=0,
+    )
+    out_b, q_b = bucketed_quantized_grad_reduce(grads, mode, quant, plan)
+    out_p, q_p = quantized_grad_reduce(grads, mode, quant)
+    for k in grads:
+        np.testing.assert_array_equal(
+            np.asarray(out_b[k]), np.asarray(out_p[k]), err_msg=k
+        )
+    if mode == "fp8_delayed":
+        for k in q_p["amax_history"]:
+            np.testing.assert_array_equal(
+                np.asarray(q_b["amax_history"][k]),
+                np.asarray(q_p["amax_history"][k]),
+                err_msg=k,
+            )
+    else:
+        assert q_b is quant
+
+    # plan=None delegates to the plain path outright
+    out_n, _ = bucketed_quantized_grad_reduce(grads, mode, quant, None)
+    for k in grads:
+        np.testing.assert_array_equal(
+            np.asarray(out_n[k]), np.asarray(out_p[k]), err_msg=k
+        )
+
+
+# ---------------------------------------------------------------------------
+# compiled-program pins
+# ---------------------------------------------------------------------------
+
+
+def _compiled_step_text(model_cfg, cfg, mesh):
+    opt = make_optimizer(cfg)
+    state, _ = init_train_state(
+        jax.random.PRNGKey(0), model_cfg, cfg, mesh, opt
+    )
+    step_fn = make_train_step(model_cfg, cfg, mesh, opt)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(
+        0, model_cfg.src_vocab_size, size=(8, cfg.seq_length + 1)
+    )
+    batch = (
+        jnp.asarray(tokens[:, :-1], jnp.int32),
+        jnp.asarray(tokens[:, 1:], jnp.int32),
+    )
+    txt = (
+        jax.jit(lambda s, b: step_fn(s, b)).lower(state, batch).compile()
+        .as_text()
+    )
+    return txt, state, step_fn, batch
+
+
+def test_dcn1_auto_is_bit_identical_to_off():
+    """On a single-slice mesh ``auto`` resolves to disabled: the traced
+    program is the byte-for-byte pre-overlap step (the "off is free"
+    acceptance pin) and carries no anchor scopes and no dcn traffic."""
+    mesh = build_mesh(MeshConfig(sharding_strategy="fsdp"))
+    txt_auto, *_ = _compiled_step_text(
+        TINY, _cfg(dcn_overlap="auto"), mesh
+    )
+    txt_off, *_ = _compiled_step_text(TINY, _cfg(dcn_overlap="off"), mesh)
+    assert txt_auto == txt_off
+    assert "dcn_bucket_reduce" not in txt_auto
+    assert plan_summary() is None
+    split = hlo_collective_split(txt_auto, mesh)
+    assert split["dcn"] == 0, split
+
+
+def test_two_slice_overlap_program_is_scheduled():
+    """The structural acceptance pin: the 2-slice overlap-on program
+    resolves a multi-bucket schedule, carries the per-bucket anchor
+    scopes, and threads >= 2 dcn collectives through backward compute
+    (interleaved, not a tail blob). The overlap-off twin has none of
+    the anchor scopes."""
+    cfg_on = _cfg(num_slices=2, dcn_overlap="auto", dcn_bucket_mb=1)
+    mesh = build_mesh(MeshConfig.from_train_config(cfg_on))
+    txt_on, *_ = _compiled_step_text(BIGGER, cfg_on, mesh)
+    sched_summary = plan_summary()
+    assert sched_summary and sched_summary["buckets"] >= 3, sched_summary
+    assert "dcn_bucket_reduce" in txt_on
+
+    sched = hlo_collective_schedule(txt_on, mesh)
+    assert sched["dcn"] >= 2, sched
+    assert sched["backward_lines"] > 0, sched
+    assert sched["interleaved_pairs"] >= 1, sched
+
+    # the anchored-off twin (no anchor scopes, plan registry cleared) is
+    # pinned on the TINY 2-slice program by
+    # test_two_slice_on_off_bit_identity — no second BIGGER compile here
+
+
+def _run_steps(cfg, n_steps=3):
+    """Train n_steps on the cfg's mesh; AOT-compile once so the compiled
+    text rides along for scope assertions at no extra compile cost."""
+    mesh = build_mesh(MeshConfig.from_train_config(cfg))
+    opt = make_optimizer(cfg)
+    state, _ = init_train_state(jax.random.PRNGKey(0), TINY, cfg, mesh, opt)
+    step_fn = make_train_step(TINY, cfg, mesh, opt)
+    sched = plan_summary()
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 256, size=(8, cfg.seq_length + 1))
+    batch = (
+        jnp.asarray(tokens[:, :-1], jnp.int32),
+        jnp.asarray(tokens[:, 1:], jnp.int32),
+    )
+    compiled = (
+        jax.jit(lambda s, b: step_fn(s, b)).lower(state, batch).compile()
+    )
+    txt = compiled.as_text()
+    losses = []
+    for _ in range(n_steps):
+        state, metrics = compiled(state, batch)
+        losses.append(float(metrics["loss"]))
+        tokens = rng.integers(0, 256, size=(8, cfg.seq_length + 1))
+        batch = (
+            jnp.asarray(tokens[:, :-1], jnp.int32),
+            jnp.asarray(tokens[:, 1:], jnp.int32),
+        )
+    h = hashlib.sha256()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(arr.tobytes())
+    return losses, h.hexdigest(), sched, txt
+
+
+def test_two_slice_on_off_bit_identity():
+    """The in-process twin of the gloo e2e: 3 steps on the 2-slice mesh
+    with the anchored schedule vs the unbucketed path — losses bit-equal
+    every step, final full train state hash-identical. The compiled
+    texts double as the 2-slice scope pins: anchors present only in the
+    overlap-on program."""
+    losses_on, hash_on, sched_on, txt_on = _run_steps(
+        _cfg(num_slices=2, dcn_overlap="auto")
+    )
+    losses_off, hash_off, sched_off, txt_off = _run_steps(
+        _cfg(num_slices=2, dcn_overlap="off")
+    )
+    assert sched_on is not None and sched_off is None
+    assert "dcn_bucket_reduce" in txt_on
+    assert "dcn_bucket_reduce" not in txt_off
+    assert losses_on == losses_off, (losses_on, losses_off)
+    assert hash_on == hash_off
+
+
+def test_observer_overlap_frac():
+    """The v10 dcn_overlap_frac estimate: 0.0 without a schedule or dcn
+    signal; with K buckets and ample backward compute only the first
+    bucket's reduce is exposed (frac = 1 - 1/K); with no compute to hide
+    under, nothing overlaps."""
+    from fms_fsdp_tpu.obs.observer import Observer
+
+    obs = Observer()
+    assert obs._overlap_frac({"dcn_collective": 1.0, "compute": 9.0}) == 0.0
+    obs.attach_overlap_schedule({"buckets": 4, "bytes_per_bucket": [1] * 4})
+    assert obs._overlap_frac({"dcn_collective": 0.0, "compute": 9.0}) == 0.0
+    assert obs._overlap_frac(
+        {"dcn_collective": 1.0, "compute": 30.0}
+    ) == pytest.approx(0.75)
+    assert obs._overlap_frac(
+        {"dcn_collective": 1.0, "compute": 0.0}
+    ) == pytest.approx(0.0)
+    obs.attach_overlap_schedule(None)
+    assert obs._overlap_frac({"dcn_collective": 1.0, "compute": 30.0}) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# gloo e2e: 2-slice x 2-host world, overlap on vs off
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_gloo_two_slice_overlap_bit_identity(tmp_path):
+    """The multi-process acceptance pin: a 2-slice x 2-host gloo world
+    (4 procs, 4 virtual devices each — mesh dcn=2, fsdp=8) trained 4
+    steps over real arrow data with ``dcn_overlap=auto`` commits exactly
+    the state the ``dcn_overlap=off`` world commits — STATE_HASH
+    bit-identical — and its metrics.jsonl carries the v10
+    ``dcn_overlap_frac`` field."""
+    import json
+    import os
+
+    from test_elastic import _grab, _launch_world, _marked_corpus
+
+    data = _marked_corpus(tmp_path / "data", doc_len=80)
+    hashes = {}
+    for mode in ("off", "auto"):
+        ckpt = str(tmp_path / f"ckpt_{mode}")
+        walk = str(tmp_path / f"walk_{mode}")
+        obs = str(tmp_path / f"obs_{mode}")
+        os.makedirs(walk)
+        rcs, outs = _launch_world(
+            4,
+            [ckpt, data, walk, mode, "4", "4", "",
+             "num_slices=2",
+             f"slice_heartbeat_dir={tmp_path / ('hb_' + mode)}",
+             "slice_timeout_s=8",
+             f"dcn_overlap={mode}",
+             f"obs_dir={obs}"],
+        )
+        assert rcs == [0, 0, 0, 0], "\n".join(o[-2000:] for o in outs)
+        assert _grab(outs[0], "SLICE_CTX") == "2 0", outs[0][-2000:]
+        # train another 4 steps resuming the committed step-4 checkpoint
+        # so the compared hash covers a full save -> restore -> train
+        # round-trip under each schedule
+        rcs, outs = _launch_world(
+            4,
+            [ckpt, data, walk, mode + "2", "8", "4", "",
+             "num_slices=2",
+             f"slice_heartbeat_dir={tmp_path / ('hb2_' + mode)}",
+             "slice_timeout_s=8",
+             f"dcn_overlap={mode}"],
+        )
+        assert rcs == [0, 0, 0, 0], "\n".join(o[-2000:] for o in outs)
+        assert _grab(outs[0], "START_STEP") == "4", outs[0][-2000:]
+        hashes[mode] = _grab(outs[0], "STATE_HASH")
+        with open(os.path.join(obs, "metrics.jsonl")) as f:
+            recs = [json.loads(l) for l in f if l.strip()]
+        assert recs, "metrics.jsonl empty"
+        assert all("dcn_overlap_frac" in r for r in recs), recs[-1]
+        if mode == "auto":
+            # the auto world's probe ran the real bucket schedule; the
+            # estimate stays a valid fraction
+            assert all(
+                0.0 <= r["dcn_overlap_frac"] <= 1.0 for r in recs
+            ), recs[-1]
+    assert hashes["auto"] == hashes["off"], hashes

@@ -1011,7 +1011,9 @@ def test_supervisor_autorestart_slice_kill_e2e(tmp_path):
     with open(os.path.join(obs, "metrics.jsonl")) as f:
         recs = [json.loads(ln) for ln in f if ln.strip()]
     last = recs[-1]
-    assert last["schema_version"] == 7
+    from fms_fsdp_tpu.obs.schema import SCHEMA_VERSION
+
+    assert last["schema_version"] == SCHEMA_VERSION
     assert last["restarts"] >= 1
     assert last["restart_downtime_s"] > 0
 

@@ -146,6 +146,12 @@ def test_committed_table_is_valid_and_serves_bench_shapes():
     )
     assert how == "exact" and config["page_size"] > 0
     assert config["block_kv"] % config["page_size"] == 0
+    # dcn_bucket (the 7B-shaped bf16-wire reduction schedule)
+    config, how = t.lookup(
+        "dcn_bucket", "v5e", "bfloat16",
+        {"grad_mb": 13344, "leaves": 11, "slices": 2, "wire_bytes": 2},
+    )
+    assert how == "exact" and config["bucket_mb"] > 0
 
 
 def test_measured_entry_not_clobbered_by_cost_model(tmp_path):
@@ -327,6 +333,69 @@ def test_resolve_ssd_and_ce_chunks(tmp_path):
                                     requested=256) == 256
     assert lookup.resolve_ce_chunk(64, 512, "float32",
                                    requested=4096) == 4096
+
+
+def test_resolve_dcn_bucket_contract(tmp_path):
+    """resolve_dcn_bucket follows the shared resolver contract: a
+    nonzero TrainConfig.dcn_bucket_mb pins, the table answers exact,
+    and a tableless host falls back to the cost model's cheapest
+    candidate — never a blind constant."""
+    sig = cand.dcn_bucket_sig(1024, 11, 2, 2)
+    path = _table_with(
+        tmp_path,
+        [("dcn_bucket", "v5e", "bfloat16", sig, {"bucket_mb": 64})],
+    )
+    lookup.configure_kernel_tuning("auto", path, chip="v5e")
+    assert lookup.resolve_dcn_bucket(1024, 11, 2, 2, chip="v5e") == 64
+    assert lookup.choices()["dcn_bucket"]["how"] == "exact"
+    # nonzero requested = explicit operator choice, pins under auto
+    assert lookup.resolve_dcn_bucket(1024, 11, 2, 2, requested=8,
+                                     chip="v5e") == 8
+    assert lookup.choices()["dcn_bucket"]["how"] == "pinned"
+    # no dcn_bucket entry in the table: the cost model picks the
+    # cheapest modeled size instead of a blind constant
+    other = TuningTable(path=str(tmp_path / "other.json"))
+    other.add("ssd", "v5e", "bfloat16", {"seq": 4096}, {"chunk": 256},
+              source="measured", measured_ms=1.0)
+    lookup.configure_kernel_tuning("auto", other.save(), chip="v5e")
+    mb = lookup.resolve_dcn_bucket(1024, 11, 2, 2, chip="v5e")
+    cands = cand.dcn_bucket_candidates(sig, "bfloat16", "v5e")
+    assert mb == min(cands, key=lambda c: c["cost_us"])["bucket_mb"]
+    # off: requested (or the static default) wins, no table consulted
+    lookup.configure_kernel_tuning("off")
+    assert lookup.resolve_dcn_bucket(1024, 11, 2, 2, requested=16) == 16
+    assert lookup.resolve_dcn_bucket(
+        1024, 11, 2, 2) == cand.DCN_BUCKET_DEFAULT_MB
+    assert lookup.choices()["dcn_bucket"]["how"] == "off"
+
+
+def test_dcn_bucket_measured_never_clobbered(tmp_path):
+    """A measured dcn_bucket winner survives cost-model reseeding —
+    the same keep_measured discipline every kernel entry has."""
+    sig = cand.dcn_bucket_sig(2048, 11, 2, 2)
+    t = TuningTable(path=str(tmp_path / "t.json"))
+    t.add("dcn_bucket", "v5e", "bfloat16", sig, {"bucket_mb": 32},
+          source="measured", measured_ms=4.2)
+    t.add("dcn_bucket", "v5e", "bfloat16", sig, {"bucket_mb": 128},
+          source="cost_model")
+    config, _ = t.lookup("dcn_bucket", "v5e", "bfloat16", sig)
+    assert config["bucket_mb"] == 32
+
+
+def test_dcn_bucket_candidates_cost_model_shape():
+    """Candidate enumeration: every size carries a modeled cost, sizes
+    at or past the grad total collapse to one bucket and only the
+    smallest such size survives (no duplicate timings), and the cost
+    model charges more slices a longer ring."""
+    sig = cand.dcn_bucket_sig(48, 11, 2, 2)
+    cands = cand.dcn_bucket_candidates(sig, "bfloat16", "v5e")
+    assert all(c["cost_us"] > 0 for c in cands)
+    single = [c["bucket_mb"] for c in cands if c["bucket_mb"] >= 48]
+    assert single == [64]  # 64 kept, 128 pruned as a duplicate schedule
+    four = cand.dcn_bucket_cost_s(cand.dcn_bucket_sig(48, 11, 4, 2), 16,
+                                  "v5e")
+    two = cand.dcn_bucket_cost_s(sig, 16, "v5e")
+    assert four > two
 
 
 def test_configure_precedence_env_vs_config(monkeypatch, tmp_path):
@@ -718,7 +787,7 @@ def test_autotune_dry_run_candidates_and_pruning():
                 if not c.get("quant")
             )
     assert set(by_kernel) == {
-        "flash_attention", "ssd", "fused_ce", "paged_decode"
+        "flash_attention", "ssd", "fused_ce", "paged_decode", "dcn_bucket"
     }
 
 
