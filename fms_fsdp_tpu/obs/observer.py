@@ -166,6 +166,7 @@ class Observer:
         memory_allocated_bytes: Optional[int] = None,
         data_mix: Optional[Dict[str, float]] = None,
         serving: Optional[Dict[str, float]] = None,
+        serving_fleet: Optional[Dict[str, float]] = None,
         extra: Optional[Dict[str, float]] = None,
     ) -> Dict:
         """Close the phase window, derive goodput/MFU, emit to sinks.
@@ -264,6 +265,11 @@ class Observer:
             # v9: serving-engine headline map
             # (ServingEngine.serving_stats()); None on training runs
             "serving": dict(serving) if serving else None,
+            # v11: fleet-router headline map (FleetRouter.stats());
+            # None on training runs and single-engine serving
+            "serving_fleet": (
+                dict(serving_fleet) if serving_fleet else None
+            ),
             "kernel_tuning": self.kernel_tuning,
             "quantized_matmuls": self.quantized_matmuls,
             "quantized_reduce": self.quantized_reduce,

@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -109,6 +109,15 @@ SCHEMA_FIELDS = {
     # ``extra`` via the registry snapshot as usual. Absent (null) on
     # training runs.
     "serving": ("map", False),
+    # v11: serving-fleet accounting (docs/serving.md "Fleet
+    # resilience"). Flat map from FleetRouter.stats(): replicas /
+    # replicas_live, availability (replica-seconds live over owed —
+    # the restart ledger folded into one number), restarts,
+    # stalls_detected, request outcome counts (admitted / completed /
+    # expired / failed / requeued / rejected), duplicates_dropped
+    # (exactly-once dedup hits), completion_rate, p99_latency_s under
+    # churn. Absent (null) on training runs and single-engine serving.
+    "serving_fleet": ("map", False),
     # v6: self-healing supervisor accounting (docs/resilience.md
     # "Self-healing supervisor"). The relaunched run reads the
     # supervisor's restart ledger (FMS_RESTART_LEDGER) at observer
@@ -179,6 +188,11 @@ SCHEMA_DIGESTS = {
     # DCN collective time under the bucketed overlap schedule —
     # parallel/overlap.py, docs/observability.md "DCN overlap")
     10: "864cdd64b4d6f3fa3dd7e24c3e0a18f42ae118f56965c32fbfb2f0a847f7287a",
+    # v11: + serving_fleet (fleet router headline map: replica
+    # availability from the restart ledger, restarts, stalls, request
+    # outcome counts, exactly-once dedup hits, p99 under churn —
+    # docs/serving.md "Fleet resilience")
+    11: "3fa631fc73a3499c0515780e834069bd2874861a64e3bab5bd14770fdb45d513",
 }
 
 

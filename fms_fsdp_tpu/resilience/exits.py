@@ -56,6 +56,16 @@ state_divergence    9     StateDivergenceError through the entry wrapper
                           suspect, so the supervisor's policy relaunches
                           under the VERIFIED-resume rule: restore only from
                           a scrub-verified checkpoint (FMS_VERIFIED_RESUME)
+replica_loss        10    a serving replica died: ReplicaLostError through
+                          the entry wrapper, the replica child's engine
+                          failure path (serve/replica.py), or the fleet
+                          router's watchdog kill of a stalled replica
+                          (serve/fleet.py — a replica that stops
+                          heartbeating mid-stream is dead capacity even if
+                          the process is technically alive). The
+                          ReplicaSetSupervisor's keep-N policy relaunches
+                          it and the router requeues its in-flight
+                          requests (recompute-on-resume, zero drops)
 ==================  ====  ===================================================
 
 ``classify_world`` merges one incarnation's per-host exit codes into the
@@ -93,6 +103,7 @@ EXIT_CODES: Dict[str, int] = {
     "injected_kill": 7,
     "corpus_loss": 8,
     "state_divergence": 9,
+    "replica_loss": 10,
 }
 
 # most-causal-first: when one incarnation's hosts exit with different
@@ -111,6 +122,9 @@ CLASSIFY_PRIORITY = (
     # (verified-resume) restart policy
     "state_divergence",
     "anomaly_abort",
+    # a serving replica's death is the cause; its peers (if a future
+    # sharded replica spans processes) echo as slice/watchdog exits
+    "replica_loss",
     "slice_loss",
     "watchdog_stall",
     "preempted",
@@ -204,6 +218,12 @@ def classify_exception(e: BaseException) -> Optional[str]:
         from fms_fsdp_tpu.resilience.divergence import StateDivergenceError
 
         checks.append((StateDivergenceError, "state_divergence"))
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from fms_fsdp_tpu.serve.fleet import ReplicaLostError
+
+        checks.append((ReplicaLostError, "replica_loss"))
     except Exception:  # noqa: BLE001
         pass
     for typ, name in checks:

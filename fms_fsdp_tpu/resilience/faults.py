@@ -73,6 +73,20 @@ dcn_reduce_stall
                 cross-slice reduce whose hang the slice/step watchdogs
                 must convert into an actionable report instead of a
                 burned reservation
+replica_kill    the serving replica loop's engine-iteration boundary
+                (serve/replica.py): hard-exits the replica process with
+                ``code`` (default the ``replica_loss`` registry code) —
+                the mid-stream replica death whose in-flight requests
+                the fleet router must requeue with zero drops
+                (serve/fleet.py). Filtered by ``replica`` (index) and
+                ``step`` (engine iteration)
+replica_stall   the same replica-loop boundary: parks the replica in a
+                ``seconds``-long sleep (default 3600) WITHOUT dying —
+                heartbeats stop while the process lives, the hang class
+                the router's stall watchdog must detect, kill, classify
+                ``replica_loss``, and relaunch (a wedged replica is
+                dead capacity; waiting on it drops every stream it
+                holds)
 corpus_kill     SamplingDataset document boundaries and re-probe
                 attempts (data/streaming.py): a match simulates every
                 owned shard of the named corpus dying at once — the
@@ -92,7 +106,7 @@ variable or ``TrainConfig.faults``::
 
 Filter params are matched against the call-site context before firing:
 ``path`` / ``op`` / ``tier`` / ``corpus`` (substring), ``worker`` /
-``batch`` / ``step`` / ``slice`` / ``proc`` (equality). A configured filter the call site does not supply in its
+``batch`` / ``step`` / ``slice`` / ``proc`` / ``replica`` (equality). A configured filter the call site does not supply in its
 context is a non-match (the fault does not fire) — a typo'd filter must
 never degrade into firing everywhere.
 ``times=N`` caps the number of fires (per process; counters are
@@ -116,7 +130,7 @@ ENV_VAR = "FMS_FAULTS"
 # params that filter whether a call-site context matches (vs payload)
 _FILTER_KEYS = (
     "path", "op", "worker", "batch", "step", "tier", "slice", "corpus",
-    "proc",
+    "proc", "replica",
 )
 
 

@@ -1,17 +1,52 @@
 """Serving engine v1: paged KV cache, ragged paged-attention decode,
-continuous batching (docs/serving.md)."""
+continuous batching (docs/serving.md) — plus the fleet resilience layer
+(router + replica pool, docs/serving.md "Fleet resilience").
 
-from fms_fsdp_tpu.serve.engine import ServeConfig, ServingEngine
-from fms_fsdp_tpu.serve.kv_cache import PagedKVCache
+Engine names import lazily (PEP 562): ``serve.engine`` pulls in jax,
+but the fleet router, journal, and scheduler are pure orchestration
+that thin supervisor/router processes (and the exits registry's lazy
+``ReplicaLostError`` classifier) must be able to import on hosts where
+jax is absent or deliberately unloaded.
+"""
+
+from fms_fsdp_tpu.serve.fleet import (
+    FleetConfig,
+    FleetRouter,
+    ReplicaLostError,
+    RequestJournal,
+    SubprocessReplica,
+)
 from fms_fsdp_tpu.serve.scheduler import (
     ContinuousBatchingScheduler,
     Request,
+    RequestRejected,
 )
+
+_LAZY = {
+    "ServeConfig": "fms_fsdp_tpu.serve.engine",
+    "ServingEngine": "fms_fsdp_tpu.serve.engine",
+    "PagedKVCache": "fms_fsdp_tpu.serve.kv_cache",
+}
 
 __all__ = [
     "ContinuousBatchingScheduler",
+    "FleetConfig",
+    "FleetRouter",
     "PagedKVCache",
+    "ReplicaLostError",
     "Request",
+    "RequestJournal",
+    "RequestRejected",
     "ServeConfig",
     "ServingEngine",
+    "SubprocessReplica",
 ]
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

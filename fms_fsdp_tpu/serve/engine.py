@@ -40,8 +40,12 @@ from fms_fsdp_tpu.obs.registry import MetricRegistry
 from fms_fsdp_tpu.serve.decode import paged_decode_step
 from fms_fsdp_tpu.serve.kv_cache import RESERVED_PAGES, PagedKVCache
 from fms_fsdp_tpu.serve.scheduler import (
+    REJECT_DEADLINE_UNMEETABLE,
+    REJECT_OVERLOADED,
+    REJECT_TOO_LARGE,
     ContinuousBatchingScheduler,
     Request,
+    RequestRejected,
 )
 
 _DTYPES = {
@@ -67,6 +71,17 @@ class ServeConfig:
     # which keeps strict dense bit-parity
     prefill_bucket: int = 1
     max_prefill_per_step: int = 1  # prefill-decode interleave bound
+    # overload protection at admission: queued requests beyond this are
+    # rejected typed (RequestRejected reason="overloaded") instead of
+    # growing an unbounded queue; 0 = unbounded (the v1 behavior —
+    # fleet routers front their replicas with a bounded queue instead)
+    max_queue: int = 0
+    # deadline admission estimator: with a nonzero floor rate (tokens/s
+    # the operator guarantees), a submit whose deadline cannot be met
+    # even by an IDLE engine (max_new_tokens / rate > deadline_s) is
+    # rejected typed (reason="deadline_unmeetable") at the door rather
+    # than admitted, computed, and expired; 0 disables the estimate
+    min_decode_tokens_per_s: float = 0.0
     eos_token: Optional[int] = None
     # sampling (greedy default — the parity mode)
     do_sample: bool = False
@@ -148,6 +163,8 @@ class ServingEngine:
         self._table_key = None
         self._table_dev = None
         self.last_logits = None  # (B, V) of the last decode step (debug)
+        self.iterations = 0  # engine step() count (health + fault ctx)
+        self._draining = False
 
         cfg = model_cfg
 
@@ -200,31 +217,68 @@ class ServingEngine:
         deadline_s: Optional[float] = None,
     ) -> Request:
         """Queue one request. ``deadline_s`` is relative to now; a
-        request still queued past it is expired unserved."""
+        request still queued past it is expired unserved.
+
+        Raises :class:`RequestRejected` (a ValueError subclass) with a
+        machine-readable ``reason`` — ``too_large`` / ``overloaded`` /
+        ``deadline_unmeetable`` — and bumps the per-reason
+        ``serve.requests_rejected.<reason>`` counter. Typed raises, not
+        asserts: these validate USER input and must survive python -O —
+        an accepted never-fits request would head-of-line-block the
+        FIFO queue forever."""
         deadline = None if deadline_s is None else self.clock() + deadline_s
-        # real raises, not asserts: these validate USER input and must
-        # survive python -O — an accepted never-fits request would
-        # head-of-line-block the FIFO queue forever
         if len(prompt) + max_new_tokens > self.serve_cfg.max_seq_len:
-            raise ValueError(
+            self._reject(
+                REJECT_TOO_LARGE,
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_seq_len "
-                f"({self.serve_cfg.max_seq_len})"
+                f"({self.serve_cfg.max_seq_len})",
             )
         worst = self._padded_len(len(prompt) + max_new_tokens - 1) + 1
         need = self.cache.pages_needed(worst)
         total = self.cache.num_pages - RESERVED_PAGES
         if need > total:
-            raise ValueError(
+            self._reject(
+                REJECT_TOO_LARGE,
                 f"request needs up to {need} pages but the pool holds "
                 f"{total}; raise num_pages or shrink "
-                f"prompt/max_new_tokens"
+                f"prompt/max_new_tokens",
+            )
+        if (
+            self.serve_cfg.max_queue
+            and self.scheduler.queue_depth() >= self.serve_cfg.max_queue
+        ):
+            self._reject(
+                REJECT_OVERLOADED,
+                f"queue holds {self.scheduler.queue_depth()} requests "
+                f"(max_queue={self.serve_cfg.max_queue}): shedding at "
+                f"admission — back off and retry",
+            )
+        rate = self.serve_cfg.min_decode_tokens_per_s
+        if deadline_s is not None and rate > 0:
+            floor_s = max_new_tokens / rate
+            if deadline_s < floor_s:
+                self._reject(
+                    REJECT_DEADLINE_UNMEETABLE,
+                    f"deadline {deadline_s:.3f}s < {floor_s:.3f}s floor "
+                    f"({max_new_tokens} tokens at the configured "
+                    f"min_decode_tokens_per_s={rate:g}) — unmeetable "
+                    f"even by an idle engine",
+                )
+        if self._draining:
+            self._reject(
+                REJECT_OVERLOADED,
+                "engine is draining: not admitting new requests",
             )
         req = self.scheduler.submit(
             Request(list(prompt), max_new_tokens, deadline)
         )
         self.registry.counter("serve.requests_submitted").add()
         return req
+
+    def _reject(self, reason: str, msg: str):
+        self.registry.counter(f"serve.requests_rejected.{reason}").add()
+        raise RequestRejected(reason, msg)
 
     # -- prefill -----------------------------------------------------------
 
@@ -328,8 +382,17 @@ class ServingEngine:
         one ragged decode step, harvest finishes. Returns the requests
         that finished during this iteration."""
         now = self.clock()
+        self.iterations += 1
         for r in self.scheduler.expire_queued(now):
             self.registry.counter("serve.requests_expired").add()
+        # in-flight deadline expiry at the step boundary: a running
+        # request past its deadline frees its slot and pages NOW —
+        # decoding tokens nobody can use any more starves streams that
+        # can still meet theirs
+        running = [r for r in self._slots if r is not None]
+        for r in self.scheduler.expire_inflight(running, now):
+            self._release_slot(r, self._slots.index(r))
+            self.registry.counter("serve.requests_expired_inflight").add()
 
         def can_fit(req: Request) -> bool:
             n = self._padded_len(len(req.resume_prompt()))
@@ -341,7 +404,8 @@ class ServingEngine:
         # when two requests each fit alone but not together. Slots are
         # recounted live too: a request that finishes inside its own
         # prefill releases its slot immediately.
-        for _ in range(self.serve_cfg.max_prefill_per_step):
+        for _ in range(0 if self._draining else
+                       self.serve_cfg.max_prefill_per_step):
             if self._slots.count(None) <= 0:
                 break
             got = self.scheduler.admit(1, can_fit)
@@ -427,6 +491,37 @@ class ServingEngine:
             r is not None for r in self._slots
         )
 
+    # -- fleet hooks (docs/serving.md "Fleet resilience") ------------------
+
+    def drain(self) -> None:
+        """Stop admitting: queued and new requests are refused, running
+        streams finish. The fleet router drains a replica before a
+        planned stop so in-flight work completes instead of requeueing;
+        ``drained`` flips once the slots empty."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        return self._draining and all(r is None for r in self._slots)
+
+    def health(self) -> Dict[str, float]:
+        """One flat liveness snapshot (the replica loop's heartbeat
+        payload): iteration count proves forward progress, the rest
+        sizes the replica's load for the router's dispatch choice."""
+        return {
+            "iterations": float(self.iterations),
+            "slots_busy": float(
+                sum(r is not None for r in self._slots)
+            ),
+            "queue_depth": float(self.scheduler.queue_depth()),
+            "kv_pages_in_use": float(self.cache.pages_in_use),
+            "draining": float(self._draining),
+        }
+
     # -- obs ---------------------------------------------------------------
 
     def serving_stats(self) -> Dict[str, float]:
@@ -451,5 +546,8 @@ class ServingEngine:
             "requests_completed": float(self.scheduler.completed),
             "requests_evicted": float(self.scheduler.evicted),
             "requests_expired": float(self.scheduler.expired),
+            "requests_expired_inflight": float(
+                self.scheduler.expired_inflight
+            ),
             "p99_latency_s": p99,
         }

@@ -1,0 +1,846 @@
+"""Serving-fleet resilience: a router + replica pool over ServingEngine.
+
+PR 11's ``ServingEngine`` made single-process serving correct (paged KV,
+continuous batching, greedy parity vs the dense path); this module makes
+a *fleet* of those engines survive what the training stack already
+survives — process death and silent hangs — with zero dropped requests.
+
+Topology: N replica child processes (``serve/replica.py``), each running
+a full ``ServingEngine``, speak a line-delimited JSON protocol over
+stdin/stdout to one :class:`FleetRouter` in the parent:
+
+    router -> replica:  {"type": "submit", "rid", "prompt",
+                         "max_new_tokens", "deadline_s"}
+                        {"type": "drain"}
+    replica -> router:  {"type": "hb", "iterations", "completed",
+                         "slots_busy", "queue_depth"}        (heartbeat,
+                        every engine iteration and on idle ticks)
+                        {"type": "done", "rid", "tokens"}
+                        {"type": "reject", "rid", "reason"}
+
+Durability lives at the ROUTER, not the replicas: a request is journaled
+at admission (:class:`RequestJournal`) and every state transition —
+assigned to replica K incarnation ``run_id``, completed with tokens,
+requeued because that incarnation died — is a journal record. A replica
+death therefore loses only *computation*, never *requests*: the router
+requeues the dead incarnation's in-flight rids at the queue FRONT in
+their original admission order and they re-dispatch from their original
+prompts (recompute-on-resume, the same contract as single-engine
+eviction — generated prefixes are NOT reused across replicas because a
+dead replica's partial stream was never delivered). Completion is
+exactly-once: ``done`` lines are deduplicated against the journal, so a
+replica killed between emitting a completion and being reaped cannot
+double-deliver (the router drains a dead replica's remaining stdout
+BEFORE requeueing, so a completion that made it out counts and its rid
+is not recomputed).
+
+Death is detected two ways and classified through the exits registry
+(resilience/exits.py):
+
+- **exit**: the child's exit code, classified by the
+  :class:`~fms_fsdp_tpu.resilience.supervisor.ReplicaSetSupervisor`
+  (``replica_loss`` = 10 is the dedicated class; a crash or injected
+  kill classifies per its own code);
+- **stall**: a live process that stops heartbeating while it owns
+  in-flight requests (the ``replica_stall`` fault site's hang class).
+  After ``stall_timeout_s`` the router's watchdog SIGKILLs it with the
+  classification pinned to ``replica_loss`` — a wedged replica is dead
+  capacity, and waiting on it would hold every stream it owns.
+
+Relaunch is the supervisor's keep-N policy (per-replica incarnation ids
+``replica<K>-i<N>``, crash-loop guard on served-request progress,
+restart ledger folded into the **availability** metric — replica-seconds
+live over replica-seconds owed). Overload protection mirrors the
+engine's typed admission: a bounded router queue sheds ``overloaded``,
+an impossible request sheds ``too_large``, a hopeless deadline sheds
+``deadline_unmeetable`` (:class:`RequestRejected` re-raised from
+serve/scheduler.py with per-reason counters).
+
+Proof: scripts/chaos_soak_serving.py kills AND stalls replicas
+mid-stream under seeded load and asserts zero dropped requests, greedy
+token-parity vs an unfaulted fleet, and measured availability < 1.0
+(docs/serving.md "Fleet resilience"; BENCH_SERVING.json
+``fleet-under-churn``).
+
+This module imports no jax: the router is pure orchestration and must
+stay importable in thin supervisor processes (and the
+``ReplicaLostError`` it defines is lazily imported by the exits
+registry's crash-path classifier).
+"""
+
+import json
+import os
+import subprocess
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from queue import Empty, Queue
+from typing import Callable, Dict, List, Optional, Sequence
+
+from fms_fsdp_tpu.resilience.supervisor import ReplicaSetSupervisor
+from fms_fsdp_tpu.serve.scheduler import (
+    REJECT_DEADLINE_UNMEETABLE,
+    REJECT_OVERLOADED,
+    REJECT_TOO_LARGE,
+    RequestRejected,
+)
+
+
+class ReplicaLostError(RuntimeError):
+    """The fleet can no longer serve: every replica is gone (dead or
+    given up by the crash-loop guard) with work still outstanding.
+    Raised by the router's poll loop; through the classified entry
+    wrapper it exits with the ``replica_loss`` registry code (10) so an
+    outer supervisor reads the cause from the exit status."""
+
+
+# journal record states
+J_QUEUED = "queued"
+J_ASSIGNED = "assigned"
+J_COMPLETED = "completed"
+J_EXPIRED = "expired"
+J_FAILED = "failed"
+
+
+@dataclass
+class JournalRecord:
+    """One request's durable router-side state. ``rid`` is the router's
+    id (admission order — requeue ordering keys on it); the engine-side
+    rid inside a replica is private to that incarnation."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    deadline_s: Optional[float] = None  # absolute, router-clock
+    state: str = J_QUEUED
+    submit_t: float = 0.0
+    finish_t: Optional[float] = None
+    replica: Optional[int] = None  # current/last assignment
+    run_id: str = ""  # incarnation the assignment went to
+    tokens: Optional[List[int]] = None
+    requeues: int = 0
+    fail_reason: str = ""
+    # engine-reported time-to-first-token of the COMPLETING
+    # incarnation (a duration; requeue waits are visible in ``latency``
+    # instead, which spans admission to delivery on the router clock)
+    engine_ttft: Optional[float] = None
+
+    @property
+    def latency(self) -> Optional[float]:
+        if self.finish_t is None:
+            return None
+        return self.finish_t - self.submit_t
+
+
+class RequestJournal:
+    """Admission/assignment/completion journal: the router's source of
+    truth for what has been promised and what has been delivered.
+
+    Every transition appends one line to the event log (JSONL,
+    ``path``; "" disables) and mutates the in-memory record — the
+    in-memory side answers the hot-path queries (what is queued, what
+    is in flight on incarnation X, has rid Y already completed), the
+    log is the post-mortem artifact the soak inspects.
+
+    Exactly-once completion: :meth:`complete` returns False (and
+    counts a duplicate) when the rid is already terminal — the dedup
+    point that makes replica-death-after-emit safe."""
+
+    def __init__(
+        self, path: str = "", clock: Callable[[], float] = time.monotonic
+    ):
+        self.path = path
+        self.clock = clock
+        self.records: Dict[int, JournalRecord] = {}
+        self.queued: deque = deque()  # rids, dispatch order
+        # run_id -> set of rids currently assigned to that incarnation
+        self._inflight: Dict[str, set] = {}
+        self._next_rid = 0
+        self.duplicates_dropped = 0
+        self.requeued_total = 0
+        self._fh = None
+        if path:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(path, "a")
+
+    def _event(self, kind: str, rid: int, **extra) -> None:
+        if self._fh is None:
+            return
+        rec = {"event": kind, "rid": rid, "t": self.clock(), **extra}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # -- transitions -------------------------------------------------------
+
+    def admit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        deadline_s: Optional[float] = None,
+    ) -> int:
+        rid = self._next_rid
+        self._next_rid += 1
+        rec = JournalRecord(
+            rid=rid,
+            prompt=list(prompt),
+            max_new_tokens=int(max_new_tokens),
+            deadline_s=deadline_s,
+            submit_t=self.clock(),
+        )
+        self.records[rid] = rec
+        self.queued.append(rid)
+        self._event("admit", rid, prompt_len=len(rec.prompt),
+                    max_new_tokens=rec.max_new_tokens)
+        return rid
+
+    def assign(self, rid: int, replica: int, run_id: str) -> JournalRecord:
+        rec = self.records[rid]
+        assert rec.state == J_QUEUED, (rid, rec.state)
+        rec.state = J_ASSIGNED
+        rec.replica = replica
+        rec.run_id = run_id
+        self._inflight.setdefault(run_id, set()).add(rid)
+        self._event("assign", rid, replica=replica, run_id=run_id)
+        return rec
+
+    def complete(self, rid: int, tokens: Sequence[int]) -> bool:
+        """Record a delivered completion. Returns False — and drops the
+        tokens — when the rid is already terminal: the exactly-once
+        gate (a dead replica's late ``done`` line, or a replica killed
+        after emitting, must not double-deliver)."""
+        rec = self.records.get(rid)
+        if rec is None or rec.state in (J_COMPLETED, J_EXPIRED, J_FAILED):
+            self.duplicates_dropped += 1
+            self._event("duplicate_dropped", rid)
+            return False
+        if rec.state == J_ASSIGNED:
+            self._inflight.get(rec.run_id, set()).discard(rid)
+        elif rec.state == J_QUEUED:
+            # completed by an incarnation we already requeued it from
+            # (the done line raced the death sweep): deliver this copy
+            # and pull it back out of the queue — recompute would
+            # double-emit
+            try:
+                self.queued.remove(rid)
+            except ValueError:
+                pass
+        rec.state = J_COMPLETED
+        rec.tokens = list(tokens)
+        rec.finish_t = self.clock()
+        self._event("complete", rid, n_tokens=len(rec.tokens))
+        return True
+
+    def requeue_incarnation(self, run_id: str) -> List[int]:
+        """A replica incarnation died: move every rid still assigned to
+        it back to the queue FRONT, preserving original admission order
+        among themselves (lowest rid dispatches first — the same
+        position they would have held had they never been assigned).
+        Their partial streams were never delivered, so they recompute
+        from the original prompt on re-dispatch."""
+        rids = sorted(self._inflight.pop(run_id, set()))
+        for rid in reversed(rids):
+            rec = self.records[rid]
+            rec.state = J_QUEUED
+            rec.replica = None
+            rec.run_id = ""
+            rec.requeues += 1
+            self.queued.appendleft(rid)
+            self.requeued_total += 1
+            self._event("requeue", rid, from_run_id=run_id)
+        return rids
+
+    def fail(self, rid: int, reason: str) -> None:
+        rec = self.records[rid]
+        if rec.state == J_ASSIGNED:
+            self._inflight.get(rec.run_id, set()).discard(rid)
+        rec.state = J_FAILED
+        rec.fail_reason = reason
+        rec.finish_t = self.clock()
+        self._event("fail", rid, reason=reason)
+
+    def expire(self, rid: int) -> None:
+        rec = self.records[rid]
+        assert rec.state == J_QUEUED, (rid, rec.state)
+        self.queued.remove(rid)
+        rec.state = J_EXPIRED
+        rec.finish_t = self.clock()
+        self._event("expire", rid)
+
+    def expire_assigned(self, rid: int) -> bool:
+        """A replica reported it expired this request engine-side
+        (deadline passed while queued or in flight there). Terminal,
+        idempotent against races with the death sweep."""
+        rec = self.records.get(rid)
+        if rec is None or rec.state in (J_COMPLETED, J_EXPIRED, J_FAILED):
+            return False
+        if rec.state == J_ASSIGNED:
+            self._inflight.get(rec.run_id, set()).discard(rid)
+        elif rec.state == J_QUEUED:
+            try:
+                self.queued.remove(rid)
+            except ValueError:
+                pass
+        rec.state = J_EXPIRED
+        rec.finish_t = self.clock()
+        self._event("expire", rid, by="replica")
+        return True
+
+    def unassign(self, rid: int) -> None:
+        """A draining replica handed this request back unrun: back to
+        the queue front for redispatch (same recompute contract as a
+        death requeue, minus the death)."""
+        rec = self.records.get(rid)
+        if rec is None or rec.state != J_ASSIGNED:
+            return
+        self._inflight.get(rec.run_id, set()).discard(rid)
+        rec.state = J_QUEUED
+        rec.replica = None
+        rec.run_id = ""
+        self.queued.appendleft(rid)
+        self._event("returned", rid)
+
+    # -- queries -----------------------------------------------------------
+
+    def inflight(self, run_id: str) -> int:
+        return len(self._inflight.get(run_id, ()))
+
+    def counts(self) -> Dict[str, int]:
+        out = {s: 0 for s in
+               (J_QUEUED, J_ASSIGNED, J_COMPLETED, J_EXPIRED, J_FAILED)}
+        for rec in self.records.values():
+            out[rec.state] += 1
+        return out
+
+    def outstanding(self) -> int:
+        c = self.counts()
+        return c[J_QUEUED] + c[J_ASSIGNED]
+
+
+class SubprocessReplica:
+    """A replica child process handle: Popen + a reader thread draining
+    its stdout into a message queue. Satisfies the supervisor's handle
+    contract (``poll``/``kill``) and adds the router's ``send``/``recv``.
+
+    The reader thread (daemon) parses line-delimited JSON; it exits when
+    the child's stdout closes. ``recv`` drains whatever has arrived —
+    including after death, which is exactly what the router's
+    drain-before-requeue step needs."""
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        env: Optional[Dict[str, str]] = None,
+        stderr_path: Optional[str] = None,
+    ):
+        self._stderr_f = (
+            open(stderr_path, "ab") if stderr_path else subprocess.DEVNULL
+        )
+        self.proc = subprocess.Popen(
+            list(argv),
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=self._stderr_f,
+            env=env,
+        )
+        self._msgs: Queue = Queue()
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self.proc.stdout:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    self._msgs.put(json.loads(line))
+                except ValueError:
+                    # a torn line from a killed replica: drop it (its
+                    # rid stays non-terminal and recomputes)
+                    pass
+        except (OSError, ValueError):
+            pass
+
+    def send(self, msg: dict) -> bool:
+        """Write one protocol line. Returns False when the pipe is gone
+        (the death sweep will requeue whatever this failed to carry)."""
+        try:
+            self.proc.stdin.write((json.dumps(msg) + "\n").encode())
+            self.proc.stdin.flush()
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def recv(self) -> List[dict]:
+        out = []
+        while True:
+            try:
+                out.append(self._msgs.get_nowait())
+            except Empty:
+                return out
+
+    def drain_final(self, timeout_s: float = 1.0) -> List[dict]:
+        """After death: wait for the reader thread to consume the
+        pipe's remainder, then drain. This runs BEFORE requeueing the
+        dead incarnation's rids so any completion that escaped the
+        dying process is delivered exactly once instead of recomputed."""
+        self._reader.join(timeout=timeout_s)
+        return self.recv()
+
+    def poll(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if self._stderr_f is not subprocess.DEVNULL:
+            try:
+                self._stderr_f.close()
+            except OSError:
+                pass
+
+
+def make_subprocess_spawn(
+    workdir: str,
+    model_cfg: dict,
+    serve_cfg: dict,
+    *,
+    params: str = "",
+    init_seed: int = 0,
+    faults: str = "",
+    env_extra: Optional[Dict[str, str]] = None,
+    python: Optional[str] = None,
+):
+    """Build the supervisor spawn callback for real
+    ``serve/replica.py`` children. Writes the model/serve config JSONs
+    under ``workdir`` once; each spawn launches
+    ``python -m fms_fsdp_tpu.serve.replica`` with stderr teed to a
+    per-incarnation log (``workdir/replica<K>-i<N>.stderr``).
+
+    ``faults`` (an FMS_FAULTS spec) is exported ONLY to incarnation 0
+    of each replica: fault fire-counters are per process, so a
+    ``times=1`` kill spec inherited by the relaunched incarnation would
+    fire again at the same iteration and crash-loop the replica the
+    soak meant to kill once. Relaunches get the spec stripped — the
+    relaunched incarnation must be healthy, that is the point."""
+    import sys as _sys
+
+    os.makedirs(workdir, exist_ok=True)
+    mpath = os.path.join(workdir, "model_cfg.json")
+    spath = os.path.join(workdir, "serve_cfg.json")
+    with open(mpath, "w") as f:
+        json.dump(model_cfg, f)
+    with open(spath, "w") as f:
+        json.dump(serve_cfg, f)
+    py = python or _sys.executable
+
+    def spawn(ctx: dict) -> "SubprocessReplica":
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        if faults and ctx["incarnation"] == 0:
+            env["FMS_FAULTS"] = faults
+        else:
+            env.pop("FMS_FAULTS", None)
+        env["FMS_RUN_ID"] = ctx["run_id"]
+        argv = [
+            py, "-m", "fms_fsdp_tpu.serve.replica",
+            "--model-cfg", mpath,
+            "--serve-cfg", spath,
+            "--replica", str(ctx["replica"]),
+        ]
+        if params:
+            argv += ["--params", params]
+        else:
+            argv += ["--init-seed", str(init_seed)]
+        return SubprocessReplica(
+            argv,
+            env=env,
+            stderr_path=os.path.join(
+                workdir, f"{ctx['run_id']}.stderr"
+            ),
+        )
+
+    return spawn
+
+
+@dataclass
+class FleetConfig:
+    """Router-side knobs. ``max_seq_len`` mirrors the replicas'
+    ServeConfig so ``too_large`` sheds at the router instead of
+    bouncing off every replica."""
+
+    n_replicas: int = 2
+    max_seq_len: int = 0  # 0 = no router-side length check
+    max_queue: int = 0  # router admission bound; 0 = unbounded
+    max_inflight_per_replica: int = 8
+    # stall watchdog: arms per incarnation only after its FIRST
+    # heartbeat (readiness) — jax import + first-step compile on a cold
+    # replica can dwarf any sane stall timeout, and requests are only
+    # dispatched to ready replicas anyway. startup_timeout_s bounds the
+    # never-became-ready case instead.
+    stall_timeout_s: float = 10.0
+    startup_timeout_s: float = 120.0
+    min_decode_tokens_per_s: float = 0.0  # deadline admission estimator
+    journal_path: str = ""
+    ledger_path: str = ""
+    restart_backoff_s: float = 0.5
+    max_restarts_per_replica: int = 8
+    crash_loop_threshold: int = 3
+    drain_grace_s: float = 10.0
+
+
+class FleetRouter:
+    """The fleet's front door: typed admission, least-loaded dispatch,
+    heartbeat/stall watchdog, death-sweep requeue, exactly-once
+    delivery. Drive it with ``poll()`` from a loop (or
+    ``run_until_idle``); it never blocks on a replica.
+
+    ``spawn(ctx)`` builds a :class:`SubprocessReplica` (or a test
+    double) for supervisor context ``ctx`` (``replica``,
+    ``incarnation``, ``run_id``, ``restarts``)."""
+
+    def __init__(
+        self,
+        spawn: Callable[[dict], SubprocessReplica],
+        cfg: FleetConfig,
+        *,
+        clock: Callable[[], float] = time.monotonic,
+        log: Callable[[str], None] = None,
+    ):
+        self.cfg = cfg
+        self.clock = clock
+        self._log = log or (
+            lambda msg: print(f"[fleet-router] {msg}", flush=True)
+        )
+        self.journal = RequestJournal(cfg.journal_path, clock=clock)
+        self.supervisor = ReplicaSetSupervisor(
+            spawn,
+            cfg.n_replicas,
+            ledger_path=cfg.ledger_path or None,
+            max_restarts_per_replica=cfg.max_restarts_per_replica,
+            restart_backoff_s=cfg.restart_backoff_s,
+            crash_loop_threshold=cfg.crash_loop_threshold,
+            clock=clock,
+            log=self._log,
+        )
+        self._last_hb: Dict[int, float] = {}
+        self._ready: Dict[int, bool] = {}  # first hb of this incarnation
+        self._hb_stats: Dict[int, dict] = {}
+        self.completed: List[JournalRecord] = []
+        self.rejected: Dict[str, int] = {
+            REJECT_TOO_LARGE: 0,
+            REJECT_OVERLOADED: 0,
+            REJECT_DEADLINE_UNMEETABLE: 0,
+        }
+        self.expired = 0
+        self.failed = 0
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.supervisor.start()
+        now = self.clock()
+        for idx in self.supervisor.live_indices():
+            self._last_hb[idx] = now
+            self._ready[idx] = False
+        self._started = True
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        """Graceful wind-down: ask every live replica to drain (running
+        streams finish, its queued work comes back for redispatch),
+        then poll until the replicas exit clean. A replica that exits 0
+        classifies ``ok`` — the keep-N policy does NOT relaunch it."""
+        timeout_s = self.cfg.drain_grace_s if timeout_s is None else timeout_s
+        for idx in self.supervisor.live_indices():
+            handle = self.supervisor.handle(idx)
+            if handle is not None:
+                handle.send({"type": "drain"})
+        deadline = self.clock() + timeout_s
+        while self.supervisor.live_indices() and self.clock() < deadline:
+            self.poll()
+            time.sleep(0.01)
+
+    def shutdown(self) -> None:
+        self.supervisor.stop_all()
+        self.journal.close()
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int,
+        deadline_s: Optional[float] = None,
+    ) -> int:
+        """Admit a request into the journal (typed rejection on shed) —
+        the same three-reason contract as engine-level admission
+        (serve/scheduler.py), enforced before any replica sees it."""
+        need = len(prompt) + int(max_new_tokens)
+        if self.cfg.max_seq_len and need > self.cfg.max_seq_len:
+            self.rejected[REJECT_TOO_LARGE] += 1
+            raise RequestRejected(
+                REJECT_TOO_LARGE,
+                f"prompt+max_new_tokens = {need} exceeds replica "
+                f"max_seq_len {self.cfg.max_seq_len}",
+            )
+        if self.cfg.max_queue and len(self.journal.queued) >= self.cfg.max_queue:
+            self.rejected[REJECT_OVERLOADED] += 1
+            raise RequestRejected(
+                REJECT_OVERLOADED,
+                f"router queue full ({self.cfg.max_queue}); back off",
+            )
+        if (
+            deadline_s is not None
+            and self.cfg.min_decode_tokens_per_s > 0
+            and (deadline_s - self.clock())
+            < max_new_tokens / self.cfg.min_decode_tokens_per_s
+        ):
+            self.rejected[REJECT_DEADLINE_UNMEETABLE] += 1
+            raise RequestRejected(
+                REJECT_DEADLINE_UNMEETABLE,
+                f"deadline {deadline_s} unmeetable for {max_new_tokens} "
+                f"tokens at floor rate "
+                f"{self.cfg.min_decode_tokens_per_s}/s",
+            )
+        return self.journal.admit(prompt, max_new_tokens, deadline_s)
+
+    # -- the poll loop -----------------------------------------------------
+
+    def poll(self) -> List[JournalRecord]:
+        """One router tick: reap/relaunch via the supervisor, deliver
+        completions, watchdog stalls, expire hopeless queued work,
+        dispatch. Returns records COMPLETED this tick."""
+        assert self._started, "call start() first"
+        delivered: List[JournalRecord] = []
+        now = self.clock()
+
+        # 1) supervisor sweep: deaths, relaunches, give-ups
+        for ev in self.supervisor.poll():
+            idx = ev["replica"]
+            if ev["event"] == "died":
+                # drain the dead incarnation's surviving output FIRST:
+                # completions that escaped before death deliver
+                # exactly once instead of recomputing
+                handle = ev.get("handle")
+                if handle is not None:
+                    delivered.extend(
+                        self._process_msgs(idx, handle.drain_final())
+                    )
+                    handle.close()
+                requeued = self.journal.requeue_incarnation(ev["run_id"])
+                if requeued:
+                    self._log(
+                        f"replica {idx} ({ev['run_id']}) died "
+                        f"[{ev['classification']}]; requeued "
+                        f"{len(requeued)} in-flight request(s): "
+                        f"{requeued}"
+                    )
+            elif ev["event"] == "relaunched":
+                self._last_hb[idx] = now
+                self._ready[idx] = False
+            elif ev["event"] == "gave_up":
+                self._log(ev["post_mortem"])
+
+        # 2) live replicas: drain protocol messages
+        for idx in self.supervisor.live_indices():
+            handle = self.supervisor.handle(idx)
+            if handle is None:
+                continue
+            delivered.extend(self._process_msgs(idx, handle.recv()))
+
+        # 3) stall watchdog: a READY replica owning in-flight work that
+        # has not heartbeat within stall_timeout_s is wedged — kill it
+        # with the classification pinned (the death sweep requeues). A
+        # replica that never became ready (no first heartbeat: wedged
+        # in startup) is bounded by startup_timeout_s instead.
+        for idx in self.supervisor.live_indices():
+            run_id = self.supervisor.run_id(idx)
+            gap = now - self._last_hb.get(idx, now)
+            if (
+                self._ready.get(idx)
+                and self.journal.inflight(run_id) > 0
+                and gap > self.cfg.stall_timeout_s
+            ):
+                self.supervisor.kill(
+                    idx,
+                    classify_as="replica_loss",
+                    note=(
+                        f"replica_stall: no heartbeat for {gap:.1f}s "
+                        f"with {self.journal.inflight(run_id)} "
+                        f"request(s) in flight (stall_timeout_s="
+                        f"{self.cfg.stall_timeout_s})"
+                    ),
+                )
+            elif (
+                not self._ready.get(idx)
+                and gap > self.cfg.startup_timeout_s
+            ):
+                self.supervisor.kill(
+                    idx,
+                    classify_as="replica_loss",
+                    note=(
+                        f"replica never became ready within "
+                        f"startup_timeout_s={self.cfg.startup_timeout_s}"
+                    ),
+                )
+
+        # 4) expire hopeless queued requests (deadline passed while
+        # waiting for a replica — the fleet-level expire_queued)
+        for rid in [
+            r for r in self.journal.queued
+            if self.journal.records[r].deadline_s is not None
+            and now > self.journal.records[r].deadline_s
+        ]:
+            self.journal.expire(rid)
+            self.expired += 1
+
+        # 5) dispatch: least-loaded live replica first, FIFO queue
+        self._dispatch()
+
+        # 6) liveness floor: nothing live, nothing relaunching, work
+        # outstanding -> the fleet is lost
+        if (
+            self.journal.outstanding() > 0
+            and not self.supervisor.live_indices()
+            and not any(s.state == "down" for s in self.supervisor.slots)
+        ):
+            raise ReplicaLostError(
+                f"all {self.cfg.n_replicas} replica(s) failed with "
+                f"{self.journal.outstanding()} request(s) outstanding"
+            )
+        return delivered
+
+    def _process_msgs(self, idx: int, msgs: List[dict]):
+        delivered = []
+        now = self.clock()
+        for msg in msgs:
+            t = msg.get("type")
+            if t == "hb":
+                self._last_hb[idx] = now
+                self._ready[idx] = True
+                self._hb_stats[idx] = msg
+                self.supervisor.note_progress(
+                    idx, int(msg.get("completed", 0))
+                )
+            elif t == "done":
+                if self.journal.complete(msg["rid"], msg["tokens"]):
+                    rec = self.journal.records[msg["rid"]]
+                    rec.engine_ttft = msg.get("ttft")
+                    self.completed.append(rec)
+                    delivered.append(rec)
+            elif t == "expired":
+                if self.journal.expire_assigned(msg["rid"]):
+                    self.expired += 1
+            elif t == "returned":
+                self.journal.unassign(msg["rid"])
+            elif t == "reject":
+                # replica-side admission disagreement (misconfig):
+                # terminal — recomputing would reject again
+                self.journal.fail(
+                    msg["rid"], f"replica reject: {msg.get('reason')}"
+                )
+                self.failed += 1
+        return delivered
+
+    def _dispatch(self) -> None:
+        # only READY replicas take work: a cold replica (importing,
+        # compiling) would sit on assignments the others could serve
+        live = [
+            i for i in self.supervisor.live_indices()
+            if self._ready.get(i)
+        ]
+        if not live:
+            return
+        while self.journal.queued:
+            loads = [
+                (self.journal.inflight(self.supervisor.run_id(i)), i)
+                for i in live
+            ]
+            load, idx = min(loads)
+            if load >= self.cfg.max_inflight_per_replica:
+                return  # every replica is saturated; keep queued
+            rid = self.journal.queued[0]
+            rec = self.journal.records[rid]
+            handle = self.supervisor.handle(idx)
+            run_id = self.supervisor.run_id(idx)
+            # journal deadlines are absolute router-clock; the engine
+            # takes time-remaining (its clock differs from ours)
+            remaining = (
+                None
+                if rec.deadline_s is None
+                else max(0.0, rec.deadline_s - self.clock())
+            )
+            ok = handle is not None and handle.send(
+                {
+                    "type": "submit",
+                    "rid": rid,
+                    "prompt": rec.prompt,
+                    "max_new_tokens": rec.max_new_tokens,
+                    "deadline_s": remaining,
+                }
+            )
+            if not ok:
+                # pipe already gone: the supervisor sweep will reap it
+                # next tick; stop dispatching to it
+                return
+            self.journal.queued.popleft()
+            self.journal.assign(rid, idx, run_id)
+
+    def run_until_idle(
+        self, timeout_s: float = 120.0, tick_s: float = 0.01
+    ) -> None:
+        """Drive poll() until every journaled request is terminal."""
+        deadline = self.clock() + timeout_s
+        while self.journal.outstanding() > 0:
+            if self.clock() > deadline:
+                raise TimeoutError(
+                    f"fleet not idle after {timeout_s}s: "
+                    f"{self.journal.counts()}"
+                )
+            self.poll()
+            time.sleep(tick_s)
+
+    # -- stats -------------------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        """The obs ``serving_fleet`` map (schema v11)."""
+        c = self.journal.counts()
+        lats = sorted(
+            r.latency for r in self.completed if r.latency is not None
+        )
+        p99 = (
+            lats[min(len(lats) - 1, int(0.99 * len(lats)))] if lats else 0.0
+        )
+        admitted = len(self.journal.records)
+        return {
+            "replicas": float(self.cfg.n_replicas),
+            "replicas_live": float(len(self.supervisor.live_indices())),
+            "availability": self.supervisor.availability(),
+            "restarts": float(self.supervisor.restarts()),
+            "stalls_detected": float(self.supervisor.stalls_detected),
+            "requests_admitted": float(admitted),
+            "requests_completed": float(c[J_COMPLETED]),
+            "requests_expired": float(c[J_EXPIRED]),
+            "requests_failed": float(c[J_FAILED]),
+            "requests_requeued": float(self.journal.requeued_total),
+            "duplicates_dropped": float(self.journal.duplicates_dropped),
+            "requests_rejected": float(sum(self.rejected.values())),
+            "p99_latency_s": float(p99),
+            "completion_rate": (
+                float(c[J_COMPLETED]) / admitted if admitted else 1.0
+            ),
+        }

@@ -1,0 +1,248 @@
+"""Serving replica child: one ServingEngine behind the fleet protocol.
+
+Launched by the :class:`~fms_fsdp_tpu.serve.fleet.FleetRouter` (via the
+ReplicaSetSupervisor's spawn callback), this process speaks the
+line-delimited JSON protocol on stdin/stdout documented in
+serve/fleet.py: ``submit``/``drain`` in, ``hb``/``done``/``reject`` out.
+stdout is the protocol channel — nothing else may print there (jax and
+tracebacks go to stderr, which the router redirects to a per-incarnation
+log file).
+
+A heartbeat goes out after every engine iteration and on idle ticks; the
+router's stall watchdog keys on its absence. Two fault sites fire at the
+engine-iteration boundary (resilience/faults.py):
+
+- ``replica_kill``: hard-exit with ``code`` (default the
+  ``replica_loss`` registry code) — mid-stream replica death;
+- ``replica_stall``: park in a ``seconds``-long sleep (default 3600)
+  without dying — heartbeats stop, the hang the watchdog must convert
+  into a kill + relaunch.
+
+Both filter on ``replica`` (index, equality) and ``step`` (engine
+iteration), so a soak schedule can kill replica 1 exactly at iteration 5
+of whichever incarnation reaches it first (``FMS_FAULTS`` is inherited
+through the environment; ``times=1`` stops the relaunched incarnation
+from dying at its own iteration 5).
+
+Engine failures exit through :func:`classified_exit` — an engine
+exception classifies as ``replica_loss`` (the replica is the unit that
+died; the router requeues and the supervisor relaunches), surfaced as
+:class:`ReplicaLostError` so the registry's lazy classifier maps it.
+
+Weights come from ``--params`` (a training checkpoint — pickle,
+step_N_ckp dir, or checkpoints/ root) or ``--init-seed`` (deterministic
+random init — two replicas or two whole fleets given the same seed serve
+bit-identical greedy streams, which is what the chaos soak's
+token-parity assertion keys on).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from queue import Empty, Queue
+
+
+def _emit(msg: dict) -> None:
+    sys.stdout.write(json.dumps(msg) + "\n")
+    sys.stdout.flush()
+
+
+def _stdin_reader(q: Queue) -> None:
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            q.put(json.loads(line))
+        except ValueError:
+            continue  # torn router line; the router retries via requeue
+    q.put({"type": "drain"})  # stdin closed: router is gone, wind down
+
+
+def build_engine(args):
+    """Heavy imports live here: the module stays importable (for the
+    arg parser) without jax."""
+    import jax
+
+    from fms_fsdp_tpu.models.configs import LlamaConfig
+    from fms_fsdp_tpu.models.llama import init_llama_params
+    from fms_fsdp_tpu.serve.engine import ServeConfig, ServingEngine
+
+    with open(args.model_cfg) as f:
+        model_cfg = LlamaConfig(**json.load(f))
+    with open(args.serve_cfg) as f:
+        serve_cfg = ServeConfig(**json.load(f))
+    if args.params:
+        return ServingEngine.from_checkpoint(
+            args.params, model_cfg, serve_cfg
+        )
+    params = init_llama_params(
+        jax.random.PRNGKey(args.init_seed), model_cfg
+    )
+    return ServingEngine(params, model_cfg, serve_cfg)
+
+
+def serve_loop(engine, replica_idx: int, idle_sleep_s: float = 0.02):
+    """The replica's life: drain router messages, step the engine,
+    stream completions and heartbeats. Returns when drained."""
+    from fms_fsdp_tpu.resilience.faults import fire_fault
+    from fms_fsdp_tpu.serve.scheduler import RequestRejected
+
+    inbox: Queue = Queue()
+    reader = threading.Thread(
+        target=_stdin_reader, args=(inbox,), daemon=True
+    )
+    reader.start()
+
+    by_req = {}  # engine Request (identity) -> router rid
+    draining = False
+
+    # Warm up BEFORE the readiness heartbeat: the first step pays the
+    # prefill + decode jit compile, which can dwarf the router's stall
+    # timeout — a replica must not advertise readiness (and take
+    # dispatched work) until a step is cheap. The warmup request is
+    # engine-local; its completion is subtracted from the heartbeat's
+    # progress count.
+    warmup = engine.submit(
+        [0] * min(8, engine.serve_cfg.max_seq_len // 2), 2
+    )
+    while engine.has_work():
+        engine.step()
+    warmup_completed = engine.scheduler.completed
+
+    def heartbeat():
+        h = engine.health()
+        _emit(
+            {
+                "type": "hb",
+                "replica": replica_idx,
+                "iterations": int(h["iterations"]),
+                "completed": int(
+                    engine.scheduler.completed - warmup_completed
+                ),
+                "slots_busy": int(h["slots_busy"]),
+                "queue_depth": int(h["queue_depth"]),
+            }
+        )
+
+    heartbeat()  # readiness: the router only dispatches after this
+    while True:
+        # 1) ingest router messages
+        while True:
+            try:
+                msg = inbox.get_nowait()
+            except Empty:
+                break
+            if msg.get("type") == "submit":
+                try:
+                    req = engine.submit(
+                        msg["prompt"],
+                        msg["max_new_tokens"],
+                        deadline_s=msg.get("deadline_s"),
+                    )
+                    by_req[id(req)] = (req, msg["rid"])
+                except RequestRejected as e:
+                    _emit(
+                        {
+                            "type": "reject",
+                            "rid": msg["rid"],
+                            "reason": e.reason,
+                        }
+                    )
+            elif msg.get("type") == "drain":
+                draining = True
+                engine.drain()
+                # engine.drain() stops admission; whatever is still in
+                # the engine QUEUE will never run here — hand it back
+                # to the router for redispatch (running streams finish)
+                for req in list(engine.scheduler.queue):
+                    ent = by_req.pop(id(req), None)
+                    if ent is not None:
+                        _emit({"type": "returned", "rid": ent[1]})
+                engine.scheduler.queue.clear()
+
+        # 2) fault sites: the engine-iteration boundary (mid-stream
+        # when requests are in flight)
+        p = fire_fault(
+            "replica_stall", replica=replica_idx, step=engine.iterations
+        )
+        if p is not None:
+            time.sleep(float(p.get("seconds", 3600)))
+        p = fire_fault(
+            "replica_kill", replica=replica_idx, step=engine.iterations
+        )
+        if p is not None:
+            from fms_fsdp_tpu.resilience.exits import EXIT_CODES
+
+            sys.stderr.write(
+                f"injected replica_kill at iteration "
+                f"{engine.iterations}\n"
+            )
+            sys.stderr.flush()
+            os._exit(int(p.get("code", EXIT_CODES["replica_loss"])))
+
+        # 3) step + stream completions
+        if engine.has_work():
+            for req in engine.step():
+                ent = by_req.pop(id(req), None)
+                if ent is None:
+                    continue
+                _emit(
+                    {
+                        "type": "done",
+                        "rid": ent[1],
+                        "tokens": list(req.generated),
+                        # engine-side time-to-first-token (a duration,
+                        # so clock domains don't matter to the router)
+                        "ttft": req.ttft,
+                    }
+                )
+            # engine-side deadline expiries (queued or in-flight) never
+            # come back from step(); the router must still terminalize
+            # their journal records
+            for key, (req, rid) in list(by_req.items()):
+                if req.state == "expired":
+                    _emit({"type": "expired", "rid": rid})
+                    del by_req[key]
+            heartbeat()
+        else:
+            heartbeat()
+            if draining:
+                return
+            time.sleep(idle_sleep_s)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model-cfg", required=True,
+                    help="JSON file of LlamaConfig fields")
+    ap.add_argument("--serve-cfg", required=True,
+                    help="JSON file of ServeConfig fields")
+    ap.add_argument("--params", default="",
+                    help="checkpoint path (omit to random-init)")
+    ap.add_argument("--init-seed", type=int, default=0,
+                    help="PRNG seed for random init when --params is unset")
+    ap.add_argument("--replica", type=int, required=True,
+                    help="replica index (fault-site filter key)")
+    args = ap.parse_args(argv)
+
+    from fms_fsdp_tpu.resilience.exits import classified_exit
+    from fms_fsdp_tpu.serve.fleet import ReplicaLostError
+
+    with classified_exit():
+        try:
+            engine = build_engine(args)
+            serve_loop(engine, args.replica)
+        except (SystemExit, KeyboardInterrupt):
+            raise
+        except Exception as e:  # noqa: BLE001 — replica death boundary
+            raise ReplicaLostError(
+                f"replica {args.replica} engine failure: {e!r}"
+            ) from e
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,19 @@ Policy pieces, all deterministic (the clock is injected):
 - **Deadlines**: a request may carry an absolute deadline; requests
   whose deadline passes while still queued are expired (rejected
   without compute) — queue pressure sheds load at the cheap end first.
+  A request whose deadline passes while *in flight* is expired at the
+  engine's step boundary too (``expire_inflight``): its answer can no
+  longer be useful, so every further decode token it would consume is
+  stolen from streams that can still meet theirs. Its pages free
+  immediately (``serve.requests_expired_inflight``).
+- **Typed admission rejection**: ``submit`` on the engine raises
+  :class:`RequestRejected` with a machine-readable ``reason`` —
+  ``too_large`` (can never fit the pool), ``overloaded`` (bounded
+  queue full: load is shed at admission with a typed error the client
+  can back off on, never an unbounded queue collapse), or
+  ``deadline_unmeetable`` (the deadline cannot be met even by an idle
+  engine). One counter per reason
+  (``serve.requests_rejected.<reason>``).
 - **Eviction** (token-granular): when a *running* sequence cannot get
   its next page, the engine evicts the most-recently-admitted running
   request (LIFO preemption — it has the least sunk decode work), frees
@@ -43,6 +56,28 @@ EVICTED = "evicted"
 EXPIRED = "expired"
 
 _rid = itertools.count()
+
+# RequestRejected.reason values (the typed-admission enum)
+REJECT_TOO_LARGE = "too_large"
+REJECT_OVERLOADED = "overloaded"
+REJECT_DEADLINE_UNMEETABLE = "deadline_unmeetable"
+REJECT_REASONS = (
+    REJECT_TOO_LARGE, REJECT_OVERLOADED, REJECT_DEADLINE_UNMEETABLE,
+)
+
+
+class RequestRejected(ValueError):
+    """Typed admission rejection: ``reason`` is one of REJECT_REASONS.
+
+    Subclasses ValueError so pre-typed callers that caught the bare
+    raise keep working; new callers switch on ``reason`` (a shed
+    ``overloaded`` request should back off and retry, a ``too_large``
+    one never should)."""
+
+    def __init__(self, reason: str, msg: str):
+        assert reason in REJECT_REASONS, reason
+        super().__init__(msg)
+        self.reason = reason
 
 
 @dataclass
@@ -96,6 +131,7 @@ class ContinuousBatchingScheduler:
         self.completed = 0
         self.evicted = 0
         self.expired = 0
+        self.expired_inflight = 0
 
     # -- queue side --------------------------------------------------------
 
@@ -128,6 +164,28 @@ class ContinuousBatchingScheduler:
             r.state = EXPIRED
             r.finish_time = now
             self.expired += 1
+        return dead
+
+    def expire_inflight(
+        self, running: List[Request], now: Optional[float] = None
+    ) -> List[Request]:
+        """The in-flight half of deadline expiry: RUNNING requests whose
+        absolute deadline already passed. Unlike queued expiry (which
+        spares served work — see ``expire_queued``), a past-deadline
+        running request is expired regardless of sunk cost: its answer
+        can no longer arrive in time, so every further decode step it
+        takes is stolen from streams that can still meet their
+        deadlines. The engine calls this at the step boundary and frees
+        the victims' pages (``serve.requests_expired_inflight``)."""
+        now = self.clock() if now is None else now
+        dead = [
+            r for r in running
+            if r.deadline is not None and now > r.deadline
+        ]
+        for r in dead:
+            r.state = EXPIRED
+            r.finish_time = now
+            self.expired_inflight += 1
         return dead
 
     # -- admission ---------------------------------------------------------

@@ -10,6 +10,13 @@ completion — and reports the three serving headline numbers:
   which is exactly what the metric is for);
 - ``p99_latency_s``: p99 end-to-end request latency.
 
+Every row additionally reports per-request ``availability``
+(completed / submitted), so the steady-state rows and the
+``fleet-under-churn`` row (a 2-replica fleet with one replica
+hard-killed mid-stream — serve/fleet.py) share one schema; the churn
+row also carries ``replica_availability`` (the restart-ledger capacity
+metric, < 1.0 under churn) and the relaunch/requeue counts.
+
 Fallback-tier contract (bench.py's): the engine measures on whatever
 backend answers — on a TPU-less host the numbers are CPU-relative but
 MEASURED, so the record carries ``degraded: false`` with
@@ -22,6 +29,7 @@ Env knobs: BENCH_SERVING_REQUESTS / _PROMPT / _NEW / _BATCH / _SEQ.
 """
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -59,6 +67,12 @@ _ROW_REQUIRED = {
     "requests_completed": int,
     "requests_evicted": int,
     "kv_pages_peak": int,
+    # per-request availability = completed / submitted, on EVERY row —
+    # steady-state rows and the fleet-under-churn row share one
+    # schema. Under churn the fleet's zero-drop contract keeps this at
+    # 1.0 while the row's replica_availability records the capacity
+    # actually lost to the injected death (< 1.0).
+    "availability": (int, float),
 }
 
 
@@ -167,6 +181,98 @@ def run_row(params, cfg, max_batch, n_requests, prompt_len, max_new,
         "requests_completed": sum(r.state == "finished" for r in reqs),
         "requests_evicted": sum(r.evictions > 0 for r in reqs),
         "kv_pages_peak": int(pages_peak),
+        "availability": round(
+            sum(r.state == "finished" for r in reqs) / max(1, len(reqs)),
+            4,
+        ),
+    }
+
+
+def run_fleet_row(model_cfg_dict):
+    """The ``fleet-under-churn`` row: a 2-replica fleet over the same
+    model, with one replica hard-killed mid-stream (the chaos-soak kill
+    schedule). Throughput and p99 here are END-TO-END under churn —
+    relaunch downtime and requeue recompute included — and the row
+    carries both availabilities: per-request (completed/submitted,
+    1.0 by the zero-drop contract) and replica (ledger-folded
+    capacity, measured < 1.0)."""
+    import tempfile
+    import time as _time
+
+    from fms_fsdp_tpu.serve.fleet import (
+        FleetConfig,
+        FleetRouter,
+        make_subprocess_spawn,
+    )
+
+    serve_cfg = {
+        "max_batch": BATCH,
+        "max_seq_len": SEQ,
+        "page_size": 16,
+        "prefill_bucket": 8,
+        "max_prefill_per_step": 1,
+    }
+    wdir = tempfile.mkdtemp(prefix="bench_fleet_")
+    spawn = make_subprocess_spawn(
+        wdir,
+        model_cfg_dict,
+        serve_cfg,
+        init_seed=0,
+        faults="replica_kill:replica=1:step=12:times=1",
+    )
+    cfg = FleetConfig(
+        n_replicas=2,
+        max_seq_len=SEQ,
+        max_inflight_per_replica=BATCH,
+        stall_timeout_s=30.0,
+        startup_timeout_s=300.0,
+        restart_backoff_s=0.2,
+        ledger_path=os.path.join(wdir, "ledger.json"),
+    )
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(
+        0, model_cfg_dict["src_vocab_size"], size=(REQUESTS, PROMPT)
+    )
+    router = FleetRouter(spawn, cfg)
+    router.start()
+    t0 = _time.monotonic()
+    rids = [router.submit(p.tolist(), NEW) for p in prompts]
+    router.run_until_idle(timeout_s=600.0)
+    wall = _time.monotonic() - t0
+    stats = router.stats()
+    router.drain()
+    router.shutdown()
+    recs = [router.journal.records[r] for r in rids]
+    lats = [r.latency for r in recs if r.latency is not None]
+    ttfts = [r.engine_ttft for r in recs if r.engine_ttft is not None]
+    gen = sum(len(r.tokens) for r in recs if r.tokens)
+    completed = sum(r.state == "completed" for r in recs)
+    return {
+        "mode": "fleet-under-churn",
+        "max_batch": BATCH,
+        "requests": REQUESTS,
+        "prompt_len": PROMPT,
+        "max_new_tokens": NEW,
+        "page_size": serve_cfg["page_size"],
+        "kv_quant": "none",
+        "tokens_per_sec": round(gen / wall, 1) if wall else 0.0,
+        "ttft_s": {
+            "mean": round(sum(ttfts) / max(1, len(ttfts)), 4),
+            "p50": round(_pct(ttfts, 0.5), 4),
+            "p99": round(_pct(ttfts, 0.99), 4),
+        },
+        "p50_latency_s": round(_pct(lats, 0.5), 4),
+        "p99_latency_s": round(_pct(lats, 0.99), 4),
+        "requests_completed": completed,
+        "requests_evicted": 0,
+        "kv_pages_peak": 0,
+        "availability": round(completed / max(1, len(recs)), 4),
+        "replica_availability": round(stats["availability"], 6),
+        "replicas": int(stats["replicas"]),
+        "restarts": int(stats["restarts"]),
+        "requests_requeued": int(stats["requests_requeued"]),
     }
 
 
@@ -215,6 +321,11 @@ def main():
         # oversubscribed: 2x the requests on the same batch — queue
         # wait lands in TTFT, the continuous-batching stress shape
         run_row(params, cfg, BATCH, 2 * REQUESTS, PROMPT, NEW),
+        # 2-replica fleet with one replica killed mid-stream: the
+        # serving numbers under churn (docs/serving.md "Fleet
+        # resilience"; the same schedule scripts/chaos_soak_serving.py
+        # asserts zero-drop token parity on)
+        run_fleet_row(dataclasses.asdict(cfg)),
     ]
     backend = jax.default_backend()
     result = {

@@ -1,0 +1,244 @@
+"""Serving-fleet chaos soak: kill AND stall replicas mid-stream, prove
+zero dropped requests, exactly-once token parity, and measured
+availability < 1.0.
+
+Three fleet runs over the SAME seeded request wave against the same
+deterministically-initialized tiny model (greedy, reference attention,
+float32 — the bit-parity mode PR 11's anchor proved batch-composition-
+independent, which is what makes cross-run token comparison exact):
+
+1. **reference**: no faults — the parity baseline;
+2. **kill**: ``replica_kill`` hard-exits replica 1 mid-stream (engine
+   iteration 10 of its first incarnation, ``times=1``; the relaunched
+   incarnation gets the fault spec stripped) — exit code 10 classifies
+   ``replica_loss``, the keep-N supervisor relaunches, the router
+   requeues the dead incarnation's in-flight requests;
+3. **stall**: ``replica_stall`` parks replica 0 in a long sleep without
+   dying — heartbeats stop, the router's stall watchdog SIGKILLs it
+   with the classification pinned to ``replica_loss``, then the same
+   relaunch + requeue path runs.
+
+Asserted per faulted run: every submitted request COMPLETED (zero
+drops, zero stuck journal records), every completed response
+token-identical to the reference run (exactly-once: no duplicate, no
+divergent recompute), the restart ledger shows >= 1 relaunch with
+``replica_loss`` classification, and the ledger-folded availability is
+MEASURED < 1.0 (the churn happened) while per-request completion stays
+1.0 (nothing was dropped). The stall run must additionally detect >= 1
+stall via the watchdog. The fleet stats map is validated against the
+obs schema v11 ``serving_fleet`` field.
+
+Writes ``fleet_soak.json`` (summary) plus per-incarnation replica
+stderr logs and the request journal / restart ledger under ``--out``.
+
+Budget: tiny model (2 layers, 64-dim), CPU, ~2-4 min wall. CI runs it
+as a dedicated step (.github/workflows/pytest.yml) outside the main
+test sweep.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from fms_fsdp_tpu.serve.fleet import (  # noqa: E402
+    FleetConfig,
+    FleetRouter,
+    make_subprocess_spawn,
+)
+
+MODEL_CFG = {
+    "src_vocab_size": 128,
+    "emb_dim": 64,
+    "nheads": 4,
+    "kvheads": 2,
+    "nlayers": 2,
+    "max_expected_seq_len": 128,
+}
+SERVE_CFG = {
+    "max_batch": 4,
+    "max_seq_len": 128,
+    "page_size": 16,
+    "attn_impl": "reference",
+    "compute_dtype": "float32",  # the exact-parity numerics
+    # bucketed prefill bounds jit-compile diversity: mid-run compiles
+    # longer than the stall timeout would read as wedged replicas.
+    # Parity here is fleet-vs-fleet under identical configs, so
+    # bucketing does not loosen the token-identity assertion.
+    "prefill_bucket": 8,
+    "max_prefill_per_step": 1,
+}
+N_REQUESTS = int(os.environ.get("FLEET_SOAK_REQUESTS", "10"))
+MAX_NEW = 8
+SEED = 0
+
+
+def make_wave(n, seed):
+    """Seeded prompt wave (lengths 6..16) — identical across runs."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    wave = []
+    for _ in range(n):
+        plen = int(rng.integers(6, 17))
+        wave.append(
+            rng.integers(0, MODEL_CFG["src_vocab_size"], size=plen).tolist()
+        )
+    return wave
+
+
+def run_fleet(tag, workdir, faults=""):
+    """One fleet run over the wave. Returns (tokens_by_rid, stats,
+    ledger, wall_s)."""
+    wdir = os.path.join(workdir, tag)
+    spawn = make_subprocess_spawn(
+        wdir,
+        MODEL_CFG,
+        SERVE_CFG,
+        init_seed=SEED,
+        faults=faults,
+        env_extra={"JAX_PLATFORMS": "cpu"},
+    )
+    cfg = FleetConfig(
+        n_replicas=2,
+        max_seq_len=SERVE_CFG["max_seq_len"],
+        max_inflight_per_replica=4,
+        # above the worst single-step wall on CPU (a residual jit
+        # compile), far below the injected 600s stall
+        stall_timeout_s=10.0,
+        startup_timeout_s=180.0,
+        restart_backoff_s=0.2,
+        journal_path=os.path.join(wdir, "journal.jsonl"),
+        ledger_path=os.path.join(wdir, "ledger.json"),
+    )
+    router = FleetRouter(spawn, cfg)
+    router.start()
+    t0 = time.monotonic()
+    rids = [router.submit(p, MAX_NEW) for p in make_wave(N_REQUESTS, SEED)]
+    router.run_until_idle(timeout_s=300.0)
+    wall = time.monotonic() - t0
+    stats = router.stats()
+    router.drain()
+    router.shutdown()
+    with open(os.path.join(wdir, "ledger.json")) as f:
+        ledger = json.load(f)
+    tokens = {
+        rid: router.journal.records[rid].tokens for rid in rids
+    }
+    counts = router.journal.counts()
+    print(
+        f"[{tag}] wall {wall:.1f}s counts={counts} "
+        f"availability={stats['availability']:.4f} "
+        f"restarts={stats['restarts']:.0f} "
+        f"requeued={stats['requests_requeued']:.0f} "
+        f"stalls={stats['stalls_detected']:.0f} "
+        f"duplicates_dropped={stats['duplicates_dropped']:.0f}"
+    )
+    assert counts["completed"] == N_REQUESTS, (
+        f"[{tag}] dropped requests: {counts}"
+    )
+    return tokens, stats, ledger, wall
+
+
+def assert_faulted(tag, ref_tokens, tokens, stats, ledger):
+    # zero drops + exactly-once parity: every response token-identical
+    # to the unfaulted run's (recompute-on-resume is greedy and
+    # batch-composition-independent, so a requeued request's re-decode
+    # matches bit for bit)
+    for rid, toks in ref_tokens.items():
+        assert tokens[rid] == toks, (
+            f"[{tag}] rid {rid} tokens diverged:\n"
+            f"  ref: {toks}\n  got: {tokens[rid]}"
+        )
+    assert stats["restarts"] >= 1, f"[{tag}] no relaunch recorded"
+    assert stats["requests_requeued"] >= 1, (
+        f"[{tag}] fault landed with nothing in flight — not mid-stream"
+    )
+    # the churn is MEASURED: ledger-folded replica availability < 1.0
+    # even though per-request completion is 1.0 (nothing dropped)
+    assert 0.0 < stats["availability"] < 1.0, stats["availability"]
+    assert stats["completion_rate"] == 1.0, stats["completion_rate"]
+    classes = [e["classification"] for e in ledger["entries"]]
+    assert "replica_loss" in classes, (tag, classes)
+
+
+def validate_obs_map(stats):
+    """The fleet stats map must satisfy the obs v11 serving_fleet
+    field on a schema-valid record."""
+    from fms_fsdp_tpu.obs.schema import (
+        SCHEMA_FIELDS,
+        SCHEMA_VERSION,
+        validate_record,
+    )
+
+    rec = {}
+    for name, (tag, required) in SCHEMA_FIELDS.items():
+        if not required:
+            continue
+        rec[name] = {"int": 0, "float": 0.0, "str": "", "map": {}}[tag]
+    rec["schema_version"] = SCHEMA_VERSION
+    rec["serving_fleet"] = stats
+    errs = validate_record(rec)
+    assert not errs, errs
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="",
+                    help="artifact dir (default: a temp dir)")
+    args = ap.parse_args()
+    out = args.out or tempfile.mkdtemp(prefix="fleet_soak_")
+    os.makedirs(out, exist_ok=True)
+    print(f"serving chaos soak -> {out}")
+
+    ref_tokens, ref_stats, _, ref_wall = run_fleet("reference", out)
+    assert ref_stats["restarts"] == 0, "reference run must be unfaulted"
+
+    kill_tokens, kill_stats, kill_ledger, _ = run_fleet(
+        "kill", out, faults="replica_kill:replica=1:step=10:times=1"
+    )
+    assert_faulted("kill", ref_tokens, kill_tokens, kill_stats,
+                   kill_ledger)
+    # the injected death must classify through the registry code (10),
+    # not as a generic error
+    kill_classes = [
+        (e["exit_code"], e["classification"])
+        for e in kill_ledger["entries"]
+    ]
+    assert (10, "replica_loss") in kill_classes, kill_classes
+
+    stall_tokens, stall_stats, stall_ledger, _ = run_fleet(
+        "stall", out,
+        faults="replica_stall:replica=0:step=10:seconds=600:times=1",
+    )
+    assert_faulted("stall", ref_tokens, stall_tokens, stall_stats,
+                   stall_ledger)
+    assert stall_stats["stalls_detected"] >= 1, (
+        "watchdog never fired on the stalled replica"
+    )
+
+    validate_obs_map(kill_stats)
+
+    summary = {
+        "requests": N_REQUESTS,
+        "reference": {"wall_s": round(ref_wall, 2), **ref_stats},
+        "kill": kill_stats,
+        "stall": stall_stats,
+        "zero_drops": True,
+        "token_parity": True,
+    }
+    with open(os.path.join(out, "fleet_soak.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print("serving chaos soak PASSED: zero drops, token parity, "
+          f"kill availability {kill_stats['availability']:.4f}, "
+          f"stall availability {stall_stats['availability']:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,625 @@
+"""Serving-fleet resilience: request journal, keep-N replica
+supervision, router death/stall handling, typed admission, and the
+engine-side deadline/rejection satellites (docs/serving.md "Fleet
+resilience").
+
+The router/journal/supervisor tests run against in-process fake replica
+handles — the protocol and policy layer is pure orchestration and must
+be provable without subprocesses or jax. The end-to-end subprocess
+fleet (real ServingEngine children, injected kills and stalls, token
+parity) is scripts/chaos_soak_serving.py, run as its own CI step.
+"""
+
+import json
+import os
+
+import pytest
+
+from fms_fsdp_tpu.resilience.supervisor import (
+    ReplicaSetSupervisor,
+    default_replica_policies,
+)
+from fms_fsdp_tpu.serve.fleet import (
+    FleetConfig,
+    FleetRouter,
+    ReplicaLostError,
+    RequestJournal,
+)
+from fms_fsdp_tpu.serve.scheduler import (
+    REJECT_DEADLINE_UNMEETABLE,
+    REJECT_OVERLOADED,
+    REJECT_TOO_LARGE,
+    RequestRejected,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# request journal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_exactly_once_completion(tmp_path):
+    clk = FakeClock()
+    j = RequestJournal(str(tmp_path / "j.jsonl"), clock=clk)
+    rid = j.admit([1, 2], 4)
+    j.queued.popleft()
+    j.assign(rid, 0, "replica0-i0")
+    assert j.complete(rid, [7, 8, 9, 10]) is True
+    # the duplicate (late done line from a dying replica) is dropped
+    assert j.complete(rid, [7, 8, 9, 10]) is False
+    assert j.duplicates_dropped == 1
+    assert j.records[rid].tokens == [7, 8, 9, 10]
+    events = [
+        json.loads(line)["event"]
+        for line in open(tmp_path / "j.jsonl")
+    ]
+    assert events == ["admit", "assign", "complete", "duplicate_dropped"]
+
+
+def test_journal_requeue_front_in_admission_order():
+    j = RequestJournal(clock=FakeClock())
+    rids = [j.admit([i], 4) for i in range(5)]
+    # dispatch 0,2,4 to the doomed incarnation; 1,3 still queued
+    for rid in (0, 2, 4):
+        j.queued.remove(rid)
+        j.assign(rid, 1, "replica1-i0")
+    j.complete(rids[4], [1])  # one finished before the death
+    back = j.requeue_incarnation("replica1-i0")
+    # only the still-in-flight rids come back, at the FRONT, in
+    # original admission order — ahead of never-assigned later work
+    assert back == [0, 2]
+    assert list(j.queued) == [0, 2, 1, 3]
+    assert j.records[0].requeues == 1
+    assert j.requeued_total == 2
+
+
+def test_journal_complete_beats_requeue_race():
+    """A done line processed AFTER the death sweep requeued its rid
+    (out-of-order arrival) must still deliver once — and pull the rid
+    back out of the queue so it is not recomputed."""
+    j = RequestJournal(clock=FakeClock())
+    rid = j.admit([1], 4)
+    j.queued.popleft()
+    j.assign(rid, 0, "replica0-i0")
+    assert j.requeue_incarnation("replica0-i0") == [rid]
+    assert j.complete(rid, [5, 6]) is True
+    assert list(j.queued) == []
+    assert j.records[rid].state == "completed"
+
+
+def test_journal_expire_assigned_and_unassign():
+    j = RequestJournal(clock=FakeClock())
+    a = j.admit([1], 4)
+    b = j.admit([2], 4)
+    for rid in (a, b):
+        j.queued.remove(rid)
+        j.assign(rid, 0, "replica0-i0")
+    assert j.expire_assigned(a) is True
+    assert j.records[a].state == "expired"
+    assert j.expire_assigned(a) is False  # idempotent
+    j.unassign(b)  # drain handed it back
+    assert j.records[b].state == "queued" and list(j.queued) == [b]
+    assert j.inflight("replica0-i0") == 0
+
+
+# ---------------------------------------------------------------------------
+# keep-N replica supervision
+# ---------------------------------------------------------------------------
+
+
+class FakeHandle:
+    def __init__(self):
+        self.exit_code = None
+        self.killed = False
+
+    def poll(self):
+        return self.exit_code
+
+    def kill(self):
+        self.killed = True
+        self.exit_code = -9
+
+
+def _sup(clk, n=2, **kw):
+    handles = []
+
+    def spawn(ctx):
+        h = FakeHandle()
+        h.ctx = ctx
+        handles.append(h)
+        return h
+
+    kw.setdefault("restart_backoff_s", 1.0)
+    sup = ReplicaSetSupervisor(spawn, n, clock=clk, log=lambda m: None, **kw)
+    return sup, handles
+
+
+def test_supervisor_keep_n_relaunch_and_incarnation_ids():
+    clk = FakeClock()
+    sup, handles = _sup(clk)
+    sup.start()
+    assert [h.ctx["run_id"] for h in handles] == [
+        "replica0-i0", "replica1-i0",
+    ]
+    handles[1].exit_code = 10  # replica_loss
+    clk.t = 5.0
+    evs = sup.poll()
+    assert [e["event"] for e in evs] == ["died"]
+    assert evs[0]["classification"] == "replica_loss"
+    # replica_loss policy relaunches WITHOUT backoff
+    clk.t = 5.01
+    evs = sup.poll()
+    assert [e["event"] for e in evs] == ["relaunched"]
+    assert handles[-1].ctx["run_id"] == "replica1-i1"
+    assert sup.restarts() == 1
+    assert sup.live_indices() == [0, 1]
+
+
+def test_supervisor_clean_exit_not_relaunched():
+    clk = FakeClock()
+    sup, handles = _sup(clk)
+    sup.start()
+    handles[0].exit_code = 0  # drained clean
+    clk.t = 1.0
+    evs = sup.poll()
+    assert [e["event"] for e in evs] == ["died"]
+    assert evs[0]["classification"] == "ok"
+    clk.t = 100.0
+    assert sup.poll() == []  # never resurrected
+    assert sup.live_indices() == [1]
+
+
+def test_supervisor_pinned_classification_on_router_kill():
+    """A watchdog SIGKILL would classify as ``error`` from the raw
+    signal code; the router pins replica_loss before the exit exists."""
+    clk = FakeClock()
+    sup, handles = _sup(clk)
+    sup.start()
+    sup.kill(0, classify_as="replica_loss", note="stalled")
+    assert handles[0].killed
+    # a second kill before the reap must not double-count
+    sup.kill(0, classify_as="replica_loss", note="again")
+    clk.t = 1.0
+    evs = sup.poll()
+    assert evs[0]["classification"] == "replica_loss"
+    assert sup.stalls_detected == 1
+    assert sup.entries[-1].note == "stalled"
+
+
+def test_supervisor_crash_loop_gives_up_per_replica(tmp_path):
+    clk = FakeClock()
+    sup, handles = _sup(
+        clk, ledger_path=str(tmp_path / "ledger.json"),
+        crash_loop_threshold=2,
+    )
+    sup.start()
+    for _ in range(2):  # two no-progress deaths of replica 0
+        handles[-2 if len(handles) == 2 else -1].exit_code = None
+        live0 = [h for h in handles if h.ctx["replica"] == 0][-1]
+        live0.exit_code = 1
+        clk.t += 1.0
+        sup.poll()
+        clk.t += 10.0
+        sup.poll()  # relaunch (or give-up on the 2nd)
+    slot = sup.slots[0]
+    assert slot.state == "failed"
+    assert "no completed request" in slot.fail_reason
+    # the fleet degrades to N-1, the peer stays live
+    assert sup.live_indices() == [1]
+    led = json.loads(open(tmp_path / "ledger.json").read())
+    assert led["kind"] == "replica_set" and len(led["entries"]) == 2
+
+
+def test_supervisor_progress_resets_crash_loop_and_backoff():
+    clk = FakeClock()
+    sup, handles = _sup(clk, crash_loop_threshold=2)
+    sup.start()
+    for round_ in range(4):  # 4 deaths, each after served progress
+        sup.note_progress(0, round_ + 1)
+        [h for h in handles if h.ctx["replica"] == 0][-1].exit_code = 1
+        clk.t += 1.0
+        sup.poll()
+        clk.t += 10.0
+        assert any(
+            e["event"] == "relaunched" for e in sup.poll()
+        ), f"round {round_}: progress must keep the replica restartable"
+    assert sup.slots[0].state == "live" and sup.restarts() == 4
+
+
+def test_supervisor_availability_folds_downtime():
+    clk = FakeClock()
+    sup, handles = _sup(clk)
+    sup.start()
+    clk.t = 50.0
+    assert sup.availability() == 1.0
+    handles[0].exit_code = 10
+    sup.poll()  # death at t=50
+    clk.t = 60.0
+    sup.poll()  # relaunch at t=60 -> 10s downtime
+    clk.t = 100.0
+    # owed = 2 replicas * 100s; down = 10s
+    assert sup.availability() == pytest.approx(1.0 - 10.0 / 200.0)
+    assert sup.ledger()["availability"] < 1.0
+
+
+def test_default_replica_policies_cover_registry_classes():
+    pol = default_replica_policies()
+    assert not pol["ok"].restart
+    assert pol["replica_loss"].restart and not pol["replica_loss"].backoff
+    assert pol["error"].restart
+
+
+# ---------------------------------------------------------------------------
+# fleet router (fake replicas)
+# ---------------------------------------------------------------------------
+
+
+class FakeReplica:
+    """In-process replica double: completes each submit after
+    ``steps_per_req`` ticks, heartbeats every tick."""
+
+    def __init__(self, ctx, steps_per_req=5):
+        self.ctx = ctx
+        self.out = [{"type": "hb", "iterations": 0, "completed": 0,
+                     "slots_busy": 0, "queue_depth": 0}]  # ready at birth
+        self.dead = None
+        self.work = {}
+        self.completed = 0
+        self.steps_per_req = steps_per_req
+        self.wedged = False
+
+    def send(self, msg):
+        if self.dead is not None:
+            return False
+        if msg["type"] == "submit":
+            self.work[msg["rid"]] = [self.steps_per_req,
+                                     msg["max_new_tokens"]]
+        return True
+
+    def tick(self):
+        if self.dead is not None or self.wedged:
+            return
+        for rid, st in list(self.work.items()):
+            st[0] -= 1
+            if st[0] <= 0:
+                self.completed += 1
+                self.out.append({"type": "done", "rid": rid,
+                                 "tokens": list(range(st[1]))})
+                del self.work[rid]
+        self.out.append({"type": "hb", "iterations": 1,
+                         "completed": self.completed,
+                         "slots_busy": len(self.work), "queue_depth": 0})
+
+    def recv(self):
+        o, self.out = self.out, []
+        return o
+
+    def drain_final(self, timeout_s=1.0):
+        return self.recv()
+
+    def poll(self):
+        return self.dead
+
+    def kill(self):
+        self.dead = -9
+
+    def close(self):
+        pass
+
+
+def _fleet(clk, n=2, **cfg_kw):
+    replicas = {}
+
+    def spawn(ctx):
+        r = FakeReplica(ctx)
+        replicas[ctx["replica"]] = r
+        return r
+
+    cfg_kw.setdefault("n_replicas", n)
+    cfg_kw.setdefault("max_seq_len", 64)
+    cfg_kw.setdefault("max_inflight_per_replica", 2)
+    cfg_kw.setdefault("stall_timeout_s", 5.0)
+    cfg_kw.setdefault("restart_backoff_s", 0.1)
+    router = FleetRouter(
+        spawn, FleetConfig(**cfg_kw), clock=clk, log=lambda m: None
+    )
+    return router, replicas
+
+
+def _drive(router, replicas, clk, ticks, dt=0.5, on_tick=None):
+    done = []
+    for i in range(ticks):
+        clk.t += dt
+        for r in replicas.values():
+            r.tick()
+        if on_tick:
+            on_tick(i)
+        done += router.poll()
+    return done
+
+
+def test_router_death_requeues_and_completes_exactly_once():
+    clk = FakeClock()
+    router, replicas = _fleet(clk)
+    router.start()
+    rids = [router.submit([1, 2, 3], 4) for _ in range(8)]
+
+    def kill_early(i):
+        if i == 1:
+            replicas[0].dead = 10  # mid-stream death, work in flight
+
+    done = _drive(router, replicas, clk, 60, on_tick=kill_early)
+    assert sorted(r.rid for r in done) == rids  # all delivered, once
+    s = router.stats()
+    assert s["requests_requeued"] >= 1
+    assert s["restarts"] >= 1
+    assert s["availability"] < 1.0  # churn is measured...
+    assert s["completion_rate"] == 1.0  # ...but nothing dropped
+    assert s["duplicates_dropped"] == 0
+
+
+def test_router_drains_dead_replica_output_before_requeue():
+    """Exactly-once under the emit-then-die race: a completion sitting
+    in the dead replica's pipe is delivered, NOT recomputed — and a
+    duplicate of an already-delivered rid is dropped."""
+    clk = FakeClock()
+    router, replicas = _fleet(clk, n=1)
+    router.start()
+    rid = router.submit([1, 2, 3], 4)
+    clk.t += 0.5
+    replicas[0].tick()
+    router.poll()  # dispatched
+    # the replica finishes the request and dies before the next poll;
+    # its done line (plus a duplicate) is still in the pipe
+    replicas[0].out.append(
+        {"type": "done", "rid": rid, "tokens": [9, 9, 9, 9]}
+    )
+    replicas[0].out.append(
+        {"type": "done", "rid": rid, "tokens": [9, 9, 9, 9]}
+    )
+    replicas[0].dead = 10
+    clk.t += 0.5
+    done = router.poll()
+    assert [r.rid for r in done] == [rid]
+    assert router.journal.records[rid].tokens == [9, 9, 9, 9]
+    assert router.journal.requeued_total == 0  # delivered, not requeued
+    assert router.journal.duplicates_dropped == 1
+
+
+def test_router_stall_watchdog_kills_and_recovers():
+    clk = FakeClock()
+    router, replicas = _fleet(clk, stall_timeout_s=3.0)
+    router.start()
+    rids = [router.submit([1, 2, 3], 4) for _ in range(6)]
+    wedge_done = []
+
+    def wedge(i):
+        if i == 1:
+            replicas[1].wedged = True  # alive, no heartbeats, owns work
+
+    done = _drive(router, replicas, clk, 80, on_tick=wedge)
+    assert sorted(r.rid for r in done) == rids
+    s = router.stats()
+    assert s["stalls_detected"] >= 1
+    assert s["availability"] < 1.0
+    # the pinned classification reached the ledger
+    classes = [e.classification for e in router.supervisor.entries]
+    assert "replica_loss" in classes
+
+
+def test_router_typed_admission_rejections():
+    clk = FakeClock()
+    router, replicas = _fleet(
+        clk, max_seq_len=32, max_queue=2, min_decode_tokens_per_s=10.0
+    )
+    router.start()
+    with pytest.raises(RequestRejected) as e:
+        router.submit([1] * 30, 10)
+    assert e.value.reason == REJECT_TOO_LARGE
+    with pytest.raises(RequestRejected) as e:
+        router.submit([1], 20, deadline_s=clk() + 1.0)  # needs 2s
+    assert e.value.reason == REJECT_DEADLINE_UNMEETABLE
+    router.submit([1], 4)
+    router.submit([2], 4)
+    with pytest.raises(RequestRejected) as e:
+        router.submit([3], 4)  # bounded queue full (nothing dispatched)
+    assert e.value.reason == REJECT_OVERLOADED
+    assert router.rejected == {
+        REJECT_TOO_LARGE: 1,
+        REJECT_OVERLOADED: 1,
+        REJECT_DEADLINE_UNMEETABLE: 1,
+    }
+    assert router.stats()["requests_rejected"] == 3.0
+
+
+def test_router_expires_queued_past_deadline():
+    clk = FakeClock()
+    router, replicas = _fleet(clk, n=1, max_inflight_per_replica=1)
+    router.start()
+    keep = router.submit([1], 4)
+    rot = router.submit([2], 4, deadline_s=clk() + 1.0)  # stuck queued
+    done = _drive(router, replicas, clk, 20)
+    assert [r.rid for r in done] == [keep]
+    assert router.journal.records[rot].state == "expired"
+    assert router.stats()["requests_expired"] == 1.0
+
+
+def test_router_raises_replica_lost_when_fleet_gone():
+    clk = FakeClock()
+    router, replicas = _fleet(
+        clk, n=1, crash_loop_threshold=1, restart_backoff_s=0.1
+    )
+    router.start()
+    router.submit([1, 2], 4)
+    with pytest.raises(ReplicaLostError):
+        for i in range(50):
+            clk.t += 1.0
+            # every incarnation dies without serving -> crash-loop
+            # guard gives the replica up -> fleet lost with work owed
+            if replicas[0].dead is None:
+                replicas[0].dead = 1
+            router.poll()
+
+
+def test_replica_lost_error_classifies_to_registry_code():
+    from fms_fsdp_tpu.resilience.exits import (
+        EXIT_CODES,
+        classify_exception,
+    )
+
+    assert classify_exception(ReplicaLostError("gone")) == "replica_loss"
+    assert EXIT_CODES["replica_loss"] == 10
+
+
+# ---------------------------------------------------------------------------
+# engine satellites: in-flight expiry, typed rejection, exhaustion
+# ordering (jax on CPU, tiny model — same budget as tests/test_serving.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    import jax
+
+    from fms_fsdp_tpu.models.configs import LlamaConfig
+    from fms_fsdp_tpu.models.llama import init_llama_params
+
+    cfg = LlamaConfig(
+        src_vocab_size=128, emb_dim=64, nheads=4, kvheads=2, nlayers=2,
+        max_expected_seq_len=256,
+    )
+    return cfg, init_llama_params(jax.random.PRNGKey(0), cfg)
+
+
+def _engine(tiny_setup, clk=None, **kw):
+    from fms_fsdp_tpu.serve import ServeConfig
+    from fms_fsdp_tpu.serve.engine import ServingEngine
+
+    cfg, params = tiny_setup
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("compute_dtype", "float32")
+    kw.setdefault("attn_impl", "reference")
+    kw.setdefault("page_size", 16)
+    scfg = ServeConfig(**kw)
+    extra = {} if clk is None else {"clock": clk}
+    return ServingEngine(params, cfg, scfg, **extra)
+
+
+def test_engine_expires_inflight_past_deadline(tiny_setup):
+    """The in-flight half of deadline expiry: a RUNNING request whose
+    deadline passes is expired at the step boundary, its slot and pages
+    free immediately, and the dedicated counter ticks."""
+    clk = FakeClock()
+    eng = _engine(tiny_setup, clk=clk)
+    doomed = eng.submit([5, 9, 2, 7], 40, deadline_s=3.0)
+    healthy = eng.submit([11, 3, 8, 1], 4)
+    for _ in range(3):
+        eng.step()
+    assert doomed.state == "running" and len(doomed.generated) >= 1
+    pages_before = eng.cache.pages_in_use
+    clk.t = 10.0  # past the in-flight deadline
+    eng.step()
+    assert doomed.state == "expired"
+    assert eng.scheduler.expired_inflight == 1
+    assert eng.cache.pages_in_use < pages_before
+    eng.run()
+    assert healthy.state == "finished"
+    assert eng.serving_stats()["requests_expired_inflight"] == 1.0
+    assert (
+        eng.registry.counter("serve.requests_expired_inflight").value
+        == 1.0
+    )
+
+
+def test_engine_typed_rejection_reasons_and_counters(tiny_setup):
+    eng = _engine(
+        tiny_setup, max_queue=1, min_decode_tokens_per_s=10.0
+    )
+    with pytest.raises(RequestRejected) as e:
+        eng.submit([1] * 60, 10)  # 70 > max_seq_len 64
+    assert e.value.reason == REJECT_TOO_LARGE
+    with pytest.raises(RequestRejected) as e:
+        eng.submit([1], 40, deadline_s=1.0)  # needs 4s at the floor
+    assert e.value.reason == REJECT_DEADLINE_UNMEETABLE
+    eng.submit([1, 2], 4)
+    with pytest.raises(RequestRejected) as e:
+        eng.submit([3, 4], 4)  # bounded queue full
+    assert e.value.reason == REJECT_OVERLOADED
+    for reason in (
+        REJECT_TOO_LARGE, REJECT_OVERLOADED, REJECT_DEADLINE_UNMEETABLE,
+    ):
+        assert (
+            eng.registry.counter(
+                f"serve.requests_rejected.{reason}"
+            ).value == 1.0
+        ), reason
+    # the unknown-reason constructor is a programming error, not a shed
+    with pytest.raises(AssertionError):
+        RequestRejected("nonsense", "x")
+
+
+def test_sustained_pool_exhaustion_no_livelock(tiny_setup):
+    """Three long streams that can never ALL hold their working sets
+    (9 pages of demand vs a 4-page pool): LIFO eviction + front-requeue
+    must cycle them to completion across repeated preemption rounds,
+    not livelock (every admission prefills and yields at least one
+    token, so sunk work grows monotonically). Every final stream
+    matches its single-stream run token-for-token, and the LAST-evicted
+    stream finishes before earlier-evicted peers still behind it in the
+    queue (front-requeue: the request with the most sunk work resumes
+    first)."""
+    plans = [
+        ([5, 9, 2, 7], 40),
+        ([11, 3, 8, 1], 40),
+        ([7, 7, 7, 7], 40),
+    ]
+    # single-stream references on a roomy engine
+    refs = []
+    for p, n in plans:
+        solo = _engine(tiny_setup)
+        r = solo.submit(p, n)
+        solo.run()
+        refs.append(r.generated)
+    # each stream ends at 44 tokens = 3 pages; 3*3 > 4 -> sustained
+    # exhaustion with repeated evict/requeue rounds
+    eng = _engine(tiny_setup, max_batch=3, num_pages=4 + 2)
+    reqs = [eng.submit(p, n) for p, n in plans]
+    finish_order = []
+    for _ in range(2000):
+        if not eng.has_work():
+            break
+        finish_order += eng.step()
+    assert not eng.has_work(), "livelock: pool exhaustion never resolved"
+    assert eng.scheduler.evicted >= 2  # multiple preemption rounds
+    assert len(finish_order) == 3
+    for r, ref in zip(reqs, refs):
+        assert r.state == "finished"
+        assert r.generated == ref
+    # requeue ORDERING: victims re-admit in reverse eviction order
+    # (front-requeue), so the stream evicted LAST — the one with the
+    # most sunk work — must not finish after one evicted before it
+    evicted = [r for r in reqs if r.evictions >= 1]
+    assert len(evicted) >= 2, "pressure too low: need repeated victims"
+
+
+def test_engine_drain_refuses_admission_and_drains(tiny_setup):
+    eng = _engine(tiny_setup)
+    r1 = eng.submit([5, 9], 4)
+    eng.step()
+    eng.drain()
+    with pytest.raises(RequestRejected) as e:
+        eng.submit([1, 2], 4)  # draining engine sheds typed
+    assert e.value.reason == REJECT_OVERLOADED
+    eng.run()
+    assert r1.state == "finished" and eng.drained
+    h = eng.health()
+    assert h["draining"] == 1.0 and h["slots_busy"] == 0.0
