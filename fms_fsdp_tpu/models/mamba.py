@@ -280,6 +280,329 @@ def mamba_forward(
 
 
 # ---------------------------------------------------------------------------
+# recurrent decode (serving path — serve/families/mamba.py)
+# ---------------------------------------------------------------------------
+#
+# Serving decodes one token per step from O(1) recurrent state instead of a
+# growing kv cache: per mamba layer a conv window (the last d_conv-1 xBC
+# inputs) plus the fp32 SSD state h (H, headdim, d_state) — together a
+# fixed-size slab whose bytes never grow with generated length. Every op
+# below replays the exact per-token math of the sequence path
+# (`causal_conv1d`'s shifted-FMA sum, `ssd_scan_reference`'s recurrence,
+# the gated RMSNorm), which is what makes greedy recurrent decode bitwise
+# equal to a dense full-forward walk under fp32 + mamba_kernel="reference"
+# — the family's parity anchor (tests/test_serving_families.py). Hybrid
+# configs' attn-mixer layers ride a kv cache supplied by the caller
+# through ``attn_cb`` (dense buffers in prefill, the paged pools in
+# serve-side decode).
+
+
+def init_mamba_decode_state(
+    cfg: MambaConfig, batch: int, compute_dtype=jnp.float32
+) -> List[Params]:
+    """Per-layer recurrent decode state for ``batch`` slots.
+
+    Mamba layers: {"conv": (B, d_conv-1, conv_dim) compute dtype — the
+    sliding window of pre-conv xBC inputs; "ssd": (B, H, headdim,
+    d_state) fp32 — the carried SSD state}. Attention layers of hybrid
+    configs hold no slab here ({}): their kv lives in the caller's
+    paged pool."""
+    state: List[Params] = []
+    for i in range(cfg.n_layer):
+        if i in cfg.attn_layer_idx:
+            state.append({})
+        else:
+            state.append(
+                {
+                    "conv": jnp.zeros(
+                        (batch, cfg.d_conv - 1, _conv_dim(cfg)), compute_dtype
+                    ),
+                    "ssd": jnp.zeros(
+                        (batch, cfg.nheads, cfg.headdim, cfg.d_state),
+                        jnp.float32,
+                    ),
+                }
+            )
+    return state
+
+
+def mamba_state_bytes_per_stream(cfg: MambaConfig, compute_dtype=jnp.float32) -> int:
+    """Slab bytes one decode stream holds — constant in generated length
+    (the constant-memory claim a tier-1 test pins)."""
+    itemsize = jnp.dtype(compute_dtype).itemsize
+    n_mamba = cfg.n_layer - len(cfg.attn_layer_idx)
+    conv = (cfg.d_conv - 1) * _conv_dim(cfg) * itemsize
+    ssd = cfg.nheads * cfg.headdim * cfg.d_state * 4  # fp32
+    return n_mamba * (conv + ssd)
+
+
+def _mamba_mixer_step(x, st: Params, p: Params, cfg: MambaConfig):
+    """One token through a Mamba2 mixer. x (B, D) post-norm hidden in the
+    compute dtype; st the layer's {"conv", "ssd"} slab. Returns
+    (out (B, D), new st). Op-for-op the single-position case of
+    ``_mamba_mixer``: same split points, the conv as the same ascending-w
+    fp32 FMA sum ``causal_conv1d`` unrolls, the state update as the same
+    einsums ``ssd_scan_reference`` scans — the bit-parity contract."""
+    B, d = x.shape
+    H, Pd, G, N = cfg.nheads, cfg.headdim, cfg.ngroups, cfg.d_state
+    d_inner = cfg.d_inner
+
+    zxbcdt = x @ p["in_proj"]
+    z = zxbcdt[..., :d_inner]
+    xBC_in = zxbcdt[..., d_inner : d_inner + _conv_dim(cfg)]
+    dt_raw = zxbcdt[..., d_inner + _conv_dim(cfg) :]  # (B, H)
+
+    # causal conv over the window of the last d_conv inputs (current
+    # token included) — the position-t row of causal_conv1d's output
+    window = jnp.concatenate([st["conv"], xBC_in[:, None, :]], axis=1)
+    wf = p["conv_w"].astype(jnp.float32)
+    xBC = sum(
+        window[:, w].astype(jnp.float32) * wf[None, :, w]
+        for w in range(cfg.d_conv)
+    )
+    xBC = xBC + p["conv_b"].astype(jnp.float32)[None, :]
+    xBC = jax.nn.silu(xBC).astype(x.dtype)
+
+    xs = xBC[..., :d_inner].reshape(B, H, Pd)
+    Bm = xBC[..., d_inner : d_inner + G * N].reshape(B, G, N)
+    Cm = xBC[..., d_inner + G * N :].reshape(B, G, N)
+
+    dt = jax.nn.softplus(
+        dt_raw.astype(jnp.float32) + p["dt_bias"].astype(jnp.float32)
+    )  # (B, H) fp32
+    Af = -jnp.exp(p["A_log"].astype(jnp.float32))
+    rep = H // G
+    xf = xs.astype(jnp.float32)
+    Bf = jnp.repeat(Bm.astype(jnp.float32), rep, axis=1)
+    Cf = jnp.repeat(Cm.astype(jnp.float32), rep, axis=1)
+
+    h_ssd = st["ssd"] * jnp.exp(dt * Af)[:, :, None, None] + jnp.einsum(
+        "bh,bhn,bhp->bhpn", dt, Bf, xf
+    )
+    y = jnp.einsum("bhn,bhpn->bhp", Cf, h_ssd)
+    y = y + p["D"].astype(jnp.float32)[None, :, None] * xf
+    y = y.astype(x.dtype).reshape(B, d_inner)
+
+    y = rms_norm(y * jax.nn.silu(z), p["norm"], cfg.norm_eps)
+    out = y @ p["out_proj"]
+    return out, {"conv": window[:, 1:], "ssd": h_ssd}
+
+
+def _attn_qkv_step(h, p: Params, a, cos, sin, positions):
+    """Projections + partial rotary for one decode position of a hybrid
+    attn mixer. h (B, 1, D) post-norm; positions (B, 1) int32. Returns
+    q (B, 1, nq, hd), k/v (B, 1, nkv, hd)."""
+    B, m, _ = h.shape
+    hd = a.head_dim
+    q = (h @ p["wq"]).reshape(B, m, a.num_heads, hd)
+    k = (h @ p["wk"]).reshape(B, m, a.num_heads_kv, hd)
+    v = (h @ p["wv"]).reshape(B, m, a.num_heads_kv, hd)
+    r = a.rotary_emb_dim
+    if r and r < hd:
+        q = jnp.concatenate(
+            [apply_rotary(q[..., :r], cos, sin, positions), q[..., r:]], axis=-1
+        )
+        k = jnp.concatenate(
+            [apply_rotary(k[..., :r], cos, sin, positions), k[..., r:]], axis=-1
+        )
+    elif r:
+        q = apply_rotary(q, cos, sin, positions)
+        k = apply_rotary(k, cos, sin, positions)
+    return q, k, v
+
+
+def _stack_step(params: Params, x_t, cfg: MambaConfig, states, attn_cb):
+    """One token through the whole (heterogeneous) layer stack.
+
+    x_t (B, D) embedding row in the compute dtype; ``attn_cb(j, h, mixer)
+    -> (B, D)`` runs hybrid attn layer j (qkv + cache interaction + wo)
+    against whatever cache the caller owns. Returns (residual (B, D)
+    fp32, new per-layer states)."""
+    compute_dtype = x_t.dtype
+    residual = x_t.astype(jnp.float32)
+    new_states = []
+    attn_j = 0
+    for i, layer in enumerate(params["layers"]):
+        h = rms_norm(residual.astype(compute_dtype), layer["norm"], cfg.norm_eps)
+        if i in cfg.attn_layer_idx:
+            out = attn_cb(attn_j, h[:, None], layer["mixer"])
+            attn_j += 1
+            new_states.append(states[i])
+        else:
+            out, st = _mamba_mixer_step(h, states[i], layer["mixer"], cfg)
+            new_states.append(st)
+        residual = residual + out.astype(jnp.float32)
+        if "mlp" in layer:
+            h2 = rms_norm(
+                residual.astype(compute_dtype), layer["norm2"], cfg.norm_eps
+            )
+            residual = residual + _mlp(h2, layer["mlp"], None).astype(
+                jnp.float32
+            )
+    return residual, new_states
+
+
+def mamba_prefill(
+    params: Params,
+    tokens,
+    lengths,
+    cfg: MambaConfig,
+    *,
+    compute_dtype=jnp.float32,
+    kv_len: int = 0,
+):
+    """Prompt prefill by scanning the recurrent step over positions.
+
+    tokens (B, S_pad) int32, lengths (B,) int32 actual prompt lengths
+    (<= S_pad; state freezes per-row past its length, so bucketed
+    padding never corrupts the slab). Returns (logits (B, V) of each
+    row's last real position, per-layer state, kv) where kv is a dense
+    {"k", "v"} cache (n_attn, B, kv_len, nkv, hd) for hybrid attn layers
+    (None when the config has none) — page-multiple ``kv_len`` feeds
+    PagedKVCache.write_prompt directly. Because every position runs the
+    exact ops of the recurrent decode step, prefill state equals the
+    state a token-by-token decode of the prompt would carry, bit for
+    bit."""
+    params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    B, S_pad = tokens.shape
+    a = cfg.attn_cfg
+    n_attn = len(cfg.attn_layer_idx)
+    states = init_mamba_decode_state(cfg, B, compute_dtype)
+
+    if n_attn:
+        kv_len = kv_len or S_pad
+        assert kv_len >= S_pad, (kv_len, S_pad)
+        kv = {
+            "k": jnp.zeros(
+                (n_attn, B, kv_len, a.num_heads_kv, a.head_dim), compute_dtype
+            ),
+            "v": jnp.zeros(
+                (n_attn, B, kv_len, a.num_heads_kv, a.head_dim), compute_dtype
+            ),
+        }
+        cos, sin = rope_table(kv_len, a.rotary_emb_dim or a.head_dim, 10000.0)
+    else:
+        kv = {}
+        cos = sin = None
+
+    last_res = jnp.zeros((B, cfg.d_model), jnp.float32)
+
+    def body(carry, inp):
+        states, kv, last_res = carry
+        i, tok = inp
+        live = i < lengths  # (B,) rows still inside their prompt
+        x_t = params["embedding"][tok]
+
+        def attn_cb(j, h, mixer):
+            positions = jnp.full((B, 1), i, jnp.int32)
+            q, k, v = _attn_qkv_step(h, mixer, a, cos, sin, positions)
+            # zero padded rows' writes: the pages this buffer lands in
+            # must match the zero-beyond-prompt discipline the llama
+            # prefill keeps (kv_cache.py zero-page contract)
+            k = jnp.where(live[:, None, None, None], k, 0)
+            v = jnp.where(live[:, None, None, None], v, 0)
+            kv["k"] = lax.dynamic_update_slice(
+                kv["k"], k[None], (j, 0, i, 0, 0)
+            )
+            kv["v"] = lax.dynamic_update_slice(
+                kv["v"], v[None], (j, 0, i, 0, 0)
+            )
+            from fms_fsdp_tpu.ops.paged_attention import gqa_attend
+
+            o = gqa_attend(q, kv["k"][j], kv["v"][j], positions)
+            return o[:, 0] @ mixer["wo"]
+
+        residual, new_states = _stack_step(params, x_t, cfg, states, attn_cb)
+        states = jax.tree.map(
+            lambda n, o: jnp.where(
+                live.reshape((B,) + (1,) * (n.ndim - 1)), n, o
+            ),
+            new_states,
+            states,
+        )
+        last_res = jnp.where((i == lengths - 1)[:, None], residual, last_res)
+        return (states, kv, last_res), None
+
+    (states, kv, last_res), _ = lax.scan(
+        body,
+        (states, kv, last_res),
+        (jnp.arange(S_pad, dtype=jnp.int32), jnp.moveaxis(tokens, 0, 1)),
+    )
+    x = rms_norm(last_res.astype(compute_dtype), params["norm_f"], cfg.norm_eps)
+    logits = x @ params["lm_head"]
+    return logits, states, (kv if n_attn else None)
+
+
+def mamba_decode_step(
+    params: Params,
+    state,
+    kv_pools,
+    page_table,
+    seq_lens,
+    tokens,
+    cfg: MambaConfig,
+    *,
+    page_size: int = 0,
+    compute_dtype=jnp.float32,
+):
+    """One recurrent decode step for a ragged batch.
+
+    tokens (B,) int32 — each row's current token at position
+    ``seq_lens[b]``; ``state`` the per-layer slab (all B slots step
+    together; an idle slot's slices update with garbage it alone reads —
+    its next prefill overwrites them). Hybrid attn layers scatter k/v
+    into ``kv_pools`` (n_attn-layer paged pools) exactly like
+    serve/decode.py does for llama; pure-Mamba configs pass ``{}`` /
+    ``None`` and touch no cache at all. Returns (logits (B, V), state,
+    kv_pools)."""
+    params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    B = tokens.shape[0]
+    a = cfg.attn_cfg
+    x_t = params["embedding"][tokens]
+
+    if cfg.attn_layer_idx:
+        from fms_fsdp_tpu.ops.paged_attention import gather_pages, gqa_attend
+
+        max_seq = page_table.shape[1] * page_size
+        cos, sin = rope_table(max_seq, a.rotary_emb_dim or a.head_dim, 10000.0)
+        positions = seq_lens[:, None].astype(jnp.int32)
+        rows = jnp.arange(B)
+        page_ids = page_table[rows, seq_lens // page_size]
+        slots = seq_lens % page_size
+        new_pools = {"k": [], "v": []}
+
+        def attn_cb(j, h, mixer):
+            q, k, v = _attn_qkv_step(h, mixer, a, cos, sin, positions)
+            k_pool = kv_pools["k"][j].at[page_ids, slots].set(k[:, 0])
+            v_pool = kv_pools["v"][j].at[page_ids, slots].set(v[:, 0])
+            new_pools["k"].append(k_pool)
+            new_pools["v"].append(v_pool)
+            o = gqa_attend(
+                q,
+                gather_pages(k_pool, page_table),
+                gather_pages(v_pool, page_table),
+                positions,
+            )
+            return o[:, 0] @ mixer["wo"]
+
+    else:
+        new_pools = None
+
+        def attn_cb(j, h, mixer):  # pragma: no cover - unreachable
+            raise AssertionError("attn layer in a config without attn_layer_idx")
+
+    residual, state = _stack_step(params, x_t, cfg, state, attn_cb)
+    x = rms_norm(residual.astype(compute_dtype), params["norm_f"], cfg.norm_eps)
+    logits = x @ params["lm_head"]
+    if cfg.attn_layer_idx:
+        kv_pools = {
+            "k": jnp.stack(new_pools["k"]),
+            "v": jnp.stack(new_pools["v"]),
+        }
+    return logits, state, kv_pools
+
+
+# ---------------------------------------------------------------------------
 # sharding rulebook
 # ---------------------------------------------------------------------------
 

@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -108,6 +108,12 @@ SCHEMA_FIELDS = {
     # set (serve.decode_tokens, serve.kv_defrag_moves, ...) rides in
     # ``extra`` via the registry snapshot as usual. Absent (null) on
     # training runs.
+    # v12: the map gains ``family`` — the engine's model family as a
+    # numeric code (0=llama 1=mamba 2=mixtral; serve/families/
+    # FAMILY_CODES — the map is flat str->number, so the name travels
+    # as its code) — and ``state_bytes_per_stream``, the decode-state
+    # slab bytes one stream holds (mamba's constant-memory headline;
+    # 0.0 for families whose whole decode state is paged KV).
     "serving": ("map", False),
     # v11: serving-fleet accounting (docs/serving.md "Fleet
     # resilience"). Flat map from FleetRouter.stats(): replicas /
@@ -193,6 +199,10 @@ SCHEMA_DIGESTS = {
     # outcome counts, exactly-once dedup hits, p99 under churn —
     # docs/serving.md "Fleet resilience")
     11: "3fa631fc73a3499c0515780e834069bd2874861a64e3bab5bd14770fdb45d513",
+    # v12: serving map gains family (numeric code via
+    # serve/families.FAMILY_CODES) + state_bytes_per_stream (constant
+    # decode-slab bytes; the field set itself is unchanged)
+    12: "30df6d1be6e3214a083627b8cbb8a765d7c7e51aef6bdf4eca8fe469d13e5881",
 }
 
 

@@ -311,6 +311,17 @@ def ssd_scan(
     wrapping — GSPMD partitions it fine."""
     Bsz, S, H, P = x.shape
     G, N = Bm.shape[2], Bm.shape[3]
+    assert kernel in ("auto", "reference", "xla", "pallas"), (
+        f"unknown ssd kernel {kernel!r}"
+    )
+    if kernel == "reference":
+        # sequential per-token recurrence — the exact math the serving
+        # families' recurrent decode step replays one token at a time
+        # (serve/families/mamba.py), which is what makes the dense
+        # full-forward argmax walk a *bitwise* parity anchor for it
+        # (tests/test_serving_families.py). Never the training path: the
+        # S-step scan is the O(S) latency the chunked form exists to avoid.
+        return ssd_scan_reference(x, dt, A, Bm, Cm, D)
     # chunk length: the tuning table may override the config's static
     # value (kernel_tuning="auto"); with tuning off (or no legal entry)
     # this is exactly min(chunk_size, S) — today's behavior
@@ -325,7 +336,6 @@ def ssd_scan(
     dtf = dt.astype(jnp.float32)
     a = dtf * A.astype(jnp.float32)[None, None, :]  # (B, S, H), <= 0
 
-    assert kernel in ("auto", "xla", "pallas"), f"unknown ssd kernel {kernel!r}"
     # "auto" resolves to the XLA formulation until the fused kernel is
     # re-measured on chip (the r2 per-chunk kernel measured 2x slower
     # than the einsums — BENCH_SSD.json; the fused whole-sequence kernel

@@ -26,10 +26,20 @@ _LAZY = {
     "ServeConfig": "fms_fsdp_tpu.serve.engine",
     "ServingEngine": "fms_fsdp_tpu.serve.engine",
     "PagedKVCache": "fms_fsdp_tpu.serve.kv_cache",
+    # family registry (serve/families/): resolution helpers are
+    # jax-free, but lazy keeps serve import side-effect-light
+    "FAMILY_CODES": "fms_fsdp_tpu.serve.families",
+    "FamilyAdapter": "fms_fsdp_tpu.serve.families",
+    "family_of": "fms_fsdp_tpu.serve.families",
+    "init_params_for": "fms_fsdp_tpu.serve.families",
+    "load_model_config": "fms_fsdp_tpu.serve.families",
+    "resolve_adapter": "fms_fsdp_tpu.serve.families",
 }
 
 __all__ = [
     "ContinuousBatchingScheduler",
+    "FAMILY_CODES",
+    "FamilyAdapter",
     "FleetConfig",
     "FleetRouter",
     "PagedKVCache",
@@ -40,6 +50,10 @@ __all__ = [
     "ServeConfig",
     "ServingEngine",
     "SubprocessReplica",
+    "family_of",
+    "init_params_for",
+    "load_model_config",
+    "resolve_adapter",
 ]
 
 

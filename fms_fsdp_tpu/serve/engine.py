@@ -19,26 +19,33 @@ changing request population. The pieces:
   interleaved (at most ``max_prefill_per_step`` per iteration) through
   models/generation.py::prefill, whose cache scatters into the pages.
 
-Greedy decode on the reference attention impl is bit-identical to
-models/generation.py::generate — the parity anchor
-(tests/test_serving.py). Metrics land on the engine's MetricRegistry
-under ``serve.*`` and fold into the obs record's schema-v9 ``serving``
-map via :meth:`ServingEngine.serving_stats`.
+Since PR 17 the family-specific device work — decode-state allocation,
+prefill, the jitted ragged decode step, checkpoint resolution — lives
+in a per-family adapter (serve/families/): llama keeps its paged-KV +
+ragged-kernel path verbatim, mamba decodes from a constant-size
+recurrent slab, mixtral routes each token through its top-k experts
+over paged attention. The engine proper is family-agnostic: admission,
+continuous batching, LIFO eviction, sampling, metrics.
+
+Greedy decode on the reference impls is bit-identical to each family's
+jitted dense full-forward walk — the parity anchors
+(tests/test_serving.py, tests/test_serving_families.py). Metrics land
+on the engine's MetricRegistry under ``serve.*`` and fold into the obs
+record's schema-v12 ``serving`` map via
+:meth:`ServingEngine.serving_stats`.
 """
 
 import time
 from dataclasses import dataclass
-from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fms_fsdp_tpu.models.generation import prefill, sample_token
+from fms_fsdp_tpu.models.generation import sample_token
 from fms_fsdp_tpu.obs.registry import MetricRegistry
-from fms_fsdp_tpu.serve.decode import paged_decode_step
-from fms_fsdp_tpu.serve.kv_cache import RESERVED_PAGES, PagedKVCache
+from fms_fsdp_tpu.serve.families import FAMILY_CODES, resolve_adapter
 from fms_fsdp_tpu.serve.scheduler import (
     REJECT_DEADLINE_UNMEETABLE,
     REJECT_OVERLOADED,
@@ -87,6 +94,11 @@ class ServeConfig:
     do_sample: bool = False
     temperature: float = 1.0
     top_k: int = 10
+    # mixtral decode FFN: "routed" gathers each token's top-k experts
+    # (O(top_k/E) of the dense FLOPs, within one gather-einsum ulp of
+    # dense); "dense" replays the training-path full mixture, which is
+    # the strict bit-parity mode. Other families ignore this.
+    moe_impl: str = "routed"
 
 
 class ServingEngine:
@@ -107,88 +119,41 @@ class ServingEngine:
         self.clock = clock
         self.compute_dtype = _DTYPES[scfg.compute_dtype]
 
-        nlayers = int(params["layers"]["wq"].shape[0])
-        from fms_fsdp_tpu.tune.lookup import resolve_paged_decode
+        # family-specific device work (cache/slab, prefill + decode
+        # jits, page accounting) — resolved from the model config, with
+        # the params tree validated against it
+        self.adapter = resolve_adapter(
+            params, model_cfg, scfg, self.compute_dtype
+        )
+        self.family = self.adapter.family
+        # back-compat surface (tests, benches, fleet introspection):
+        # llama/mixtral expose their PagedKVCache here; pure-mamba has
+        # no pages, so cache is None and page_size 0
+        self.cache = self.adapter.cache
+        self.page_size = self.adapter.page_size
+        self.max_pages = self.adapter.max_pages
+        self.attn_impl = self.adapter.attn_impl
+        self.block_kv = self.adapter.block_kv
+        self.tune_how = self.adapter.tune_how
 
-        page_size, self.block_kv, self.tune_how = resolve_paged_decode(
-            scfg.max_batch,
-            model_cfg.nheads,
-            model_cfg.n_kv_heads,
-            model_cfg.head_dim,
-            scfg.max_seq_len,
-            scfg.compute_dtype,
-            requested_page_size=scfg.page_size or None,
-        )
-        assert scfg.max_seq_len % page_size == 0, (
-            scfg.max_seq_len, page_size
-        )
-        self.page_size = page_size
-        self.max_pages = scfg.max_seq_len // page_size
-        num_pages = scfg.num_pages or (
-            scfg.max_batch * self.max_pages + RESERVED_PAGES
-        )
-        self.cache = PagedKVCache(
-            nlayers,
-            num_pages,
-            page_size,
-            model_cfg.n_kv_heads,
-            model_cfg.head_dim,
-            dtype=self.compute_dtype,
-            quant=scfg.kv_quant,
-        )
         self.scheduler = ContinuousBatchingScheduler(
             scfg.max_batch,
             max_prefill_per_step=scfg.max_prefill_per_step,
             clock=clock,
         )
-        impl = scfg.attn_impl
-        if impl == "auto":
-            impl = "reference" if jax.default_backend() != "tpu" else "kernel"
-        if scfg.kv_quant != "none" and impl == "kernel":
-            impl = "reference"  # v1 kernel reads full-width pools
-        self.attn_impl = impl
 
         self._slots: List[Optional[Request]] = [None] * scfg.max_batch
         self._admit_order: List[Request] = []
         self._tokens = np.zeros((scfg.max_batch,), np.int32)
         self._lens = np.zeros((scfg.max_batch,), np.int32)
         self._key = jax.random.PRNGKey(seed)
-        self._prefill_cache: Dict = {}
         self._decode_tokens = 0
         self._prefill_tokens = 0
         self._decode_wall = 0.0
         self._finished_buf: List[Request] = []
-        # cached device page table, keyed on (allocator version, slot
-        # membership): steady-state decode re-uploads nothing
-        self._table_key = None
-        self._table_dev = None
         self.last_logits = None  # (B, V) of the last decode step (debug)
         self.iterations = 0  # engine step() count (health + fault ctx)
         self._draining = False
-
-        cfg = model_cfg
-
-        def _step(params, pools, page_table, seq_lens, tokens, key):
-            logits, _, pools = paged_decode_step(
-                params,
-                pools,
-                page_table,
-                seq_lens,
-                tokens,
-                cfg,
-                page_size=page_size,
-                compute_dtype=self.compute_dtype,
-                quant=scfg.kv_quant,
-                attn_impl=impl,
-            )
-            tok = sample_token(
-                logits, key, scfg.temperature, scfg.top_k, scfg.do_sample
-            )
-            return tok.astype(jnp.int32), logits, pools
-
-        # pools donated: the step's cache update is in-place, never a
-        # pool copy per token
-        self._decode_fn = jax.jit(_step, donate_argnums=(1,))
 
     # -- construction ------------------------------------------------------
 
@@ -199,13 +164,14 @@ class ServingEngine:
     ) -> "ServingEngine":
         """Restore params from a training checkpoint (params pickle,
         step_N_ckp dir, or a checkpoints/ root — the Checkpointer's
-        committed layout) and build the engine around them."""
-        from fms_fsdp_tpu.models.llama import init_llama_params
+        committed layout) and build the engine around them. The params
+        initializer resolves from the model config's family
+        (serve/families/) — llama, mamba and mixtral checkpoints all
+        restore through this one path."""
+        from fms_fsdp_tpu.serve.families import init_params_for
         from fms_fsdp_tpu.utils.checkpointing import load_params_only
 
-        params = load_params_only(
-            path, lambda key: init_llama_params(key, model_cfg)
-        )
+        params = load_params_only(path, init_params_for(model_cfg))
         return cls(params, model_cfg, serve_cfg, **kw)
 
     # -- request side ------------------------------------------------------
@@ -234,16 +200,9 @@ class ServingEngine:
                 f"({max_new_tokens}) exceeds max_seq_len "
                 f"({self.serve_cfg.max_seq_len})",
             )
-        worst = self._padded_len(len(prompt) + max_new_tokens - 1) + 1
-        need = self.cache.pages_needed(worst)
-        total = self.cache.num_pages - RESERVED_PAGES
-        if need > total:
-            self._reject(
-                REJECT_TOO_LARGE,
-                f"request needs up to {need} pages but the pool holds "
-                f"{total}; raise num_pages or shrink "
-                f"prompt/max_new_tokens",
-            )
+        err = self.adapter.admission_error(len(prompt), max_new_tokens)
+        if err is not None:
+            self._reject(REJECT_TOO_LARGE, err)
         if (
             self.serve_cfg.max_queue
             and self.scheduler.queue_depth() >= self.serve_cfg.max_queue
@@ -282,44 +241,14 @@ class ServingEngine:
 
     # -- prefill -----------------------------------------------------------
 
-    def _padded_len(self, n: int) -> int:
-        b = max(1, self.serve_cfg.prefill_bucket)
-        return -(-n // b) * b
-
-    def _get_prefill(self, p_len: int, s_pad: int, full_logits: bool):
-        key = (p_len, s_pad, full_logits)
-        fn = self._prefill_cache.get(key)
-        if fn is None:
-            cfg, dt = self.model_cfg, self.compute_dtype
-
-            fn = jax.jit(
-                partial(
-                    prefill,
-                    cfg=cfg,
-                    max_seq_len=s_pad,
-                    compute_dtype=dt,
-                    full_logits=full_logits,
-                )
-            )
-            self._prefill_cache[key] = fn
-        return fn
-
     def _prefill_request(self, req: Request, slot: int) -> None:
         prompt = req.resume_prompt()
         p = len(prompt)
-        p_pad = self._padded_len(p)
-        s_pad = self.cache.pages_needed(p_pad) * self.page_size
-        ok = self.cache.ensure(req.rid, p_pad)
-        assert ok, "admission checked capacity; ensure cannot fail here"
-        toks = np.zeros((1, p_pad), np.int32)
-        toks[0, :p] = prompt
-        full_logits = p_pad != p
-        logits, _, kv = self._get_prefill(p_pad, s_pad, full_logits)(
-            self.params, jnp.asarray(toks)
-        )
-        # logits of the last REAL position predict the next token
-        row = logits[0, p - 1] if full_logits else logits[0, 0]
-        self.cache.write_prompt(req.rid, kv["k"][:, 0], kv["v"][:, 0])
+        # the adapter allocates the stream's decode state (pages and/or
+        # slab slice), runs the family prefill and hands back the (V,)
+        # logits row of the last real prompt position; sampling stays
+        # here so every family shares one rng stream and one sampler
+        row = self.adapter.prefill(req.rid, slot, prompt)
         self._key, sub = jax.random.split(self._key)
         tok = int(
             sample_token(
@@ -362,7 +291,7 @@ class ServingEngine:
         return True
 
     def _release_slot(self, req: Request, slot: int) -> None:
-        self.cache.free(req.rid)
+        self.adapter.release(req.rid, slot)
         self._slots[slot] = None
         if req in self._admit_order:
             self._admit_order.remove(req)
@@ -395,8 +324,9 @@ class ServingEngine:
             self.registry.counter("serve.requests_expired_inflight").add()
 
         def can_fit(req: Request) -> bool:
-            n = self._padded_len(len(req.resume_prompt()))
-            return self.cache.can_ensure(req.rid, n + 1)
+            return self.adapter.can_admit(
+                req.rid, len(req.resume_prompt())
+            )
 
         # admit ONE at a time, prefilling (and so allocating) before the
         # next can_fit evaluation — a single batched admit would check
@@ -414,11 +344,13 @@ class ServingEngine:
             slot = self._slots.index(None)
             self._prefill_request(got[0], slot)
 
-        # token-granular page growth; evict (LIFO) when the pool is dry
+        # token-granular state growth; evict (LIFO) when the pool is
+        # dry. Constant-state families (mamba slab) always grow free —
+        # the loop never spins for them.
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
-            while not self.cache.ensure(req.rid, int(self._lens[slot]) + 1):
+            while not self.adapter.grow(req.rid, int(self._lens[slot]) + 1):
                 victim = self.scheduler.evict_victim(self._admit_order)
                 assert victim is not None, "no victim but pool exhausted"
                 self._evict(victim)
@@ -430,31 +362,13 @@ class ServingEngine:
         ]
         if active:
             t0 = self.clock()
-            key = (
-                self.cache.table_version,
-                tuple(r.rid if r is not None else None for r in self._slots),
-            )
-            if key != self._table_key:
-                self._table_key = key
-                self._table_dev = jnp.asarray(
-                    self.cache.page_table(
-                        [r.rid if r is not None else None
-                         for r in self._slots],
-                        self.max_pages,
-                    )
-                )
-            table = self._table_dev
             self._key, sub = jax.random.split(self._key)
-            toks, logits, pools = self._decode_fn(
-                self.params,
-                self.cache.pools,
-                table,
-                jnp.asarray(self._lens),
-                jnp.asarray(self._tokens),
+            toks, logits = self.adapter.decode(
+                [r.rid if r is not None else None for r in self._slots],
+                self._lens,
+                self._tokens,
                 sub,
             )
-            self.cache.pools = pools
-            toks = np.asarray(toks)
             self.last_logits = logits
             self._decode_wall += self.clock() - t0
             self._decode_tokens += len(active)
@@ -470,7 +384,7 @@ class ServingEngine:
             self.scheduler.queue_depth()
         )
         self.registry.gauge("serve.kv_pages_in_use").set(
-            self.cache.pages_in_use
+            self.adapter.pages_in_use
         )
         if self._decode_wall > 0:
             self.registry.gauge("serve.tokens_per_s").set(
@@ -518,7 +432,7 @@ class ServingEngine:
                 sum(r is not None for r in self._slots)
             ),
             "queue_depth": float(self.scheduler.queue_depth()),
-            "kv_pages_in_use": float(self.cache.pages_in_use),
+            "kv_pages_in_use": float(self.adapter.pages_in_use),
             "draining": float(self._draining),
         }
 
@@ -542,7 +456,7 @@ class ServingEngine:
             ),
             "ttft_s": ttft.get("mean", 0.0),
             "queue_depth": float(self.scheduler.queue_depth()),
-            "kv_pages_in_use": float(self.cache.pages_in_use),
+            "kv_pages_in_use": float(self.adapter.pages_in_use),
             "requests_completed": float(self.scheduler.completed),
             "requests_evicted": float(self.scheduler.evicted),
             "requests_expired": float(self.scheduler.expired),
@@ -550,4 +464,11 @@ class ServingEngine:
                 self.scheduler.expired_inflight
             ),
             "p99_latency_s": p99,
+            # v12: numeric family code (serve/families/FAMILY_CODES)
+            # + the constant per-stream recurrent-state bytes (0 for
+            # paged-KV families, whose state rides kv_pages_in_use)
+            "family": float(FAMILY_CODES[self.family]),
+            "state_bytes_per_stream": float(
+                self.adapter.state_bytes_per_stream
+            ),
         }

@@ -1,0 +1,259 @@
+"""Family adapters: one serving engine, three model families.
+
+The ServingEngine owns admission, continuous batching, eviction and
+metrics — none of which care what a "slot" stores. What differs per
+model family is (a) what decode state a stream holds, (b) how a prompt
+prefills into it, (c) what one ragged batched decode step computes, and
+(d) how a checkpoint resolves to a family in the first place. A
+:class:`FamilyAdapter` owns exactly those four things:
+
+==============  ========================================================
+family          decode-state per stream
+==============  ========================================================
+``llama``       paged KV pages (grows with generated length; the
+                PR-11 path, ragged paged-attention kernel and all —
+                untouched, still the engine's bit-parity anchor)
+``mamba``       fixed-size recurrent slab: per mamba layer a conv
+                window (d_conv-1, conv_dim) + fp32 SSD state (H,
+                headdim, d_state) — constant bytes regardless of
+                generated length; hybrid configs' attn layers ride
+                paged KV pages like llama
+``mixtral``     paged KV pages for attention + nothing for the MoE:
+                expert routing is stateless per token (top-k gather
+                of expert weights at decode)
+==============  ========================================================
+
+Every adapter is parity-anchored: greedy decode through the engine is
+bit-identical (float32 + reference impls) to the family's jitted dense
+full-forward argmax walk (tests/test_serving_families.py).
+
+This module is deliberately jax-free at import time (configs + stdlib
+only): the fleet router and replica arg parser resolve families on
+hosts where jax may be absent. Adapter classes import lazily inside
+:func:`resolve_adapter`.
+
+Obs note: the schema-v12 ``serving`` map is flat str->number, so the
+family travels as a numeric code (:data:`FAMILY_CODES`), not a string.
+"""
+
+from typing import Optional
+
+from fms_fsdp_tpu.models.configs import (
+    LlamaConfig,
+    MambaConfig,
+    MixtralConfig,
+)
+
+# the wire encoding of a family in numeric-only maps (obs schema v12
+# "serving", BENCH_SERVING.json rows): family = FAMILY_CODES[name]
+FAMILY_CODES = {"llama": 0, "mamba": 1, "mixtral": 2}
+FAMILY_NAMES = {v: k for k, v in FAMILY_CODES.items()}
+
+_CONFIG_FAMILIES = (
+    (MambaConfig, "mamba"),
+    (MixtralConfig, "mixtral"),
+    (LlamaConfig, "llama"),
+)
+
+
+def family_of(model_cfg) -> str:
+    """Model config dataclass -> family name."""
+    for cls, name in _CONFIG_FAMILIES:
+        if isinstance(model_cfg, cls):
+            return name
+    raise ValueError(
+        f"unknown model config type {type(model_cfg).__name__}: expected "
+        f"LlamaConfig, MambaConfig or MixtralConfig "
+        f"(fms_fsdp_tpu/models/configs.py)"
+    )
+
+
+def load_model_config(d: dict):
+    """Plain dict (a fleet model_cfg.json) -> the right config dataclass.
+
+    An explicit ``"family"`` key wins; otherwise the family is inferred
+    from architecture-distinguishing keys (``d_model`` -> mamba,
+    ``num_experts`` -> mixtral, else llama). This is the single
+    resolution point replica.py and the engine share — the two can no
+    longer diverge on model construction (the PR-11 bug this replaces:
+    replica.py:71 hardwired its own ``init_llama_params`` copy)."""
+    d = dict(d)
+    family = d.pop("family", None)
+    if family is None:
+        if "d_model" in d or "n_layer" in d:
+            family = "mamba"
+        elif "num_experts" in d or "top_k" in d:
+            family = "mixtral"
+        else:
+            family = "llama"
+    if family not in FAMILY_CODES:
+        raise ValueError(
+            f"unknown model family {family!r} in model config: expected "
+            f"one of {sorted(FAMILY_CODES)} — set \"family\" explicitly "
+            f"or drop it to infer from the config keys"
+        )
+    try:
+        if family == "mamba":
+            from fms_fsdp_tpu.models.configs import MambaAttnConfig
+
+            attn = d.get("attn_cfg")
+            if isinstance(attn, dict):
+                d["attn_cfg"] = MambaAttnConfig(**attn)
+            if "attn_layer_idx" in d and d["attn_layer_idx"] is not None:
+                d["attn_layer_idx"] = tuple(d["attn_layer_idx"])
+            return MambaConfig(**d)
+        if family == "mixtral":
+            return MixtralConfig(**d)
+        return LlamaConfig(**d)
+    except TypeError as e:
+        raise ValueError(
+            f"model config keys do not match the {family} family "
+            f"({type(e).__name__}: {e}) — if the family was inferred "
+            f"wrongly, set \"family\" explicitly in the model config"
+        ) from None
+
+
+def check_params_family(params, family: str) -> None:
+    """Validate a params tree actually belongs to ``family``.
+
+    Structural fingerprints: mamba stacks layers as a python list of
+    per-layer dicts; mixtral's stacked layer dict carries the router
+    ``gate``; llama's carries ``wq`` without ``gate``. A mismatch means
+    the checkpoint and the model config disagree — fail at build with
+    the fix spelled out, not at the first prefill with a shape error."""
+    layers = params.get("layers") if hasattr(params, "get") else None
+    if isinstance(layers, (list, tuple)):
+        actual = "mamba"
+    elif isinstance(layers, dict) and "gate" in layers:
+        actual = "mixtral"
+    elif isinstance(layers, dict) and "wq" in layers:
+        actual = "llama"
+    else:
+        raise ValueError(
+            "params do not look like any serveable family (no "
+            "recognizable 'layers' structure): expected init_llama_params"
+            " / init_mamba_params / init_mixtral_params output or a "
+            "checkpoint thereof"
+        )
+    if actual != family:
+        raise ValueError(
+            f"checkpoint/model-config family mismatch: params look like "
+            f"{actual!r} but the model config says {family!r} — pass the "
+            f"matching config dataclass (or fix \"family\" in "
+            f"model_cfg.json)"
+        )
+
+
+def init_params_for(model_cfg):
+    """Family -> its params initializer, ``fn(key) -> params``. The one
+    bootstrap the engine's ``from_checkpoint`` and replica.py both use."""
+    family = family_of(model_cfg)
+    if family == "mamba":
+        from fms_fsdp_tpu.models.mamba import init_mamba_params
+
+        return lambda key: init_mamba_params(key, model_cfg)
+    if family == "mixtral":
+        from fms_fsdp_tpu.models.mixtral import init_mixtral_params
+
+        return lambda key: init_mixtral_params(key, model_cfg)
+    from fms_fsdp_tpu.models.llama import init_llama_params
+
+    return lambda key: init_llama_params(key, model_cfg)
+
+
+def resolve_adapter(params, model_cfg, serve_cfg, compute_dtype=None):
+    """Checkpoint + config -> the family's adapter (jax imports here)."""
+    family = family_of(model_cfg)
+    check_params_family(params, family)
+    if family == "mamba":
+        from fms_fsdp_tpu.serve.families.mamba import MambaAdapter
+
+        return MambaAdapter(params, model_cfg, serve_cfg, compute_dtype)
+    if family == "mixtral":
+        from fms_fsdp_tpu.serve.families.mixtral import MixtralAdapter
+
+        return MixtralAdapter(params, model_cfg, serve_cfg, compute_dtype)
+    from fms_fsdp_tpu.serve.families.llama import LlamaAdapter
+
+    return LlamaAdapter(params, model_cfg, serve_cfg, compute_dtype)
+
+
+class FamilyAdapter:
+    """The protocol (docs/serving.md "Family adapters" has the table).
+
+    The engine owns scheduling, sampling, rng and metrics; the adapter
+    owns every family-specific device interaction:
+
+    - ``admission_error(prompt_len, max_new)`` — worst-case capacity
+      check at submit; a message means reject (reason=too_large).
+    - ``can_admit(rid, prompt_len)`` — would a prefill of this resumed
+      prompt fit right now (pre-admission, nothing allocated)?
+    - ``prefill(rid, slot, prompt)`` — allocate the stream's state,
+      run the family prefill, write slot state; returns the (V,)
+      logits row of the last real prompt position.
+    - ``grow(rid, n_tokens)`` — make room for the next token; False
+      triggers the engine's LIFO eviction loop. Constant-state
+      families always return True.
+    - ``release(rid, slot)`` — return the stream's state (free pages /
+      zero the slab slice). Eviction, expiry and completion all land
+      here; recompute-on-resume re-prefills into whatever slot comes
+      next.
+    - ``decode(slot_rids, lens, tokens, key)`` — one jitted ragged
+      decode step over all max_batch slots; returns (sampled tokens
+      (B,) np.int32, logits (B, V)). The adapter owns donation and
+      page-table upload caching.
+    - ``pages_in_use`` / ``state_bytes_per_stream`` — obs.
+    """
+
+    family: str = "?"
+    cache = None  # PagedKVCache when the family uses pages, else None
+    page_size: int = 0
+    max_pages: int = 0
+    attn_impl: str = "none"
+    block_kv: int = 0
+    tune_how: str = "n/a"
+
+    def admission_error(self, prompt_len: int, max_new: int) -> Optional[str]:
+        raise NotImplementedError
+
+    def can_admit(self, rid: int, prompt_len: int) -> bool:
+        raise NotImplementedError
+
+    def prefill(self, rid: int, slot: int, prompt):
+        raise NotImplementedError
+
+    def grow(self, rid: int, n_tokens: int) -> bool:
+        raise NotImplementedError
+
+    def release(self, rid: int, slot: int) -> None:
+        raise NotImplementedError
+
+    def decode(self, slot_rids, lens, tokens, key):
+        raise NotImplementedError
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.cache.pages_in_use if self.cache is not None else 0
+
+    @property
+    def state_bytes_per_stream(self) -> int:
+        """Constant per-stream recurrent-state bytes (0 for families
+        whose only decode state is paged KV — that grows, and is
+        reported through kv pages instead)."""
+        return 0
+
+    def _padded_len(self, n: int, bucket: int) -> int:
+        b = max(1, bucket)
+        return -(-n // b) * b
+
+
+__all__ = [
+    "FAMILY_CODES",
+    "FAMILY_NAMES",
+    "FamilyAdapter",
+    "check_params_family",
+    "family_of",
+    "init_params_for",
+    "load_model_config",
+    "resolve_adapter",
+]

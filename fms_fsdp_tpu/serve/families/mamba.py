@@ -1,0 +1,265 @@
+"""Mamba family adapter: constant-memory recurrent decode.
+
+A stream's decode state is a fixed-size slab (models/mamba.py::
+init_mamba_decode_state): per mamba layer the conv window plus the fp32
+SSD state. No paging, no growth — ``grow`` is always True and the slab
+bytes a stream holds (``state_bytes_per_stream``) are constant in
+generated length, which is the family's headline property
+(tests/test_serving_families.py pins it against llama's growing
+``kv_pages_in_use``).
+
+Hybrid configs (attn_layer_idx non-empty) ride the existing PagedKVCache
+for their attention layers — page accounting, LIFO eviction and
+recompute-on-resume behave exactly like llama, just over n_attn layers
+instead of all of them.
+
+Slab lifecycle: ``release`` zeroes the slot's slab slice (eviction,
+expiry and completion all land there), and the jitted decode step masks
+its state writes to live rows, so an idle slot's slab stays exactly
+zero between streams — recompute-on-resume then re-prefills the full
+resumed prompt into a clean slice.
+"""
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fms_fsdp_tpu.models.generation import sample_token
+from fms_fsdp_tpu.models.mamba import (
+    init_mamba_decode_state,
+    mamba_decode_step,
+    mamba_prefill,
+    mamba_state_bytes_per_stream,
+)
+from fms_fsdp_tpu.serve.families import FamilyAdapter
+from fms_fsdp_tpu.serve.kv_cache import RESERVED_PAGES, PagedKVCache
+
+
+class MambaAdapter(FamilyAdapter):
+    family = "mamba"
+
+    def __init__(self, params, model_cfg, scfg, compute_dtype=None):
+        from fms_fsdp_tpu.serve.engine import _DTYPES
+
+        self.params = params
+        self.model_cfg = model_cfg
+        self.scfg = scfg
+        self.compute_dtype = compute_dtype or _DTYPES[scfg.compute_dtype]
+        cfg = model_cfg
+        self._hybrid = bool(cfg.attn_layer_idx)
+
+        if scfg.attn_impl == "kernel":
+            raise ValueError(
+                "mamba serving has no paged-attention kernel path yet: "
+                "set attn_impl to 'auto' or 'reference' (the recurrent "
+                "mixer is not attention; hybrid attn layers decode "
+                "through the reference gqa_attend)"
+            )
+        if scfg.kv_quant != "none":
+            raise ValueError(
+                "mamba serving stores its recurrent slab unquantized and "
+                "hybrid attn pages full-width: set kv_quant='none'"
+            )
+        self.attn_impl = "reference" if self._hybrid else "none"
+
+        if self._hybrid:
+            a = cfg.attn_cfg
+            # default page size: no tuning-table entry for the hybrid
+            # attn shape yet — 16 matches the table's common resolution
+            # and keeps max_seq_len divisible in every test config
+            self.page_size = scfg.page_size or 16
+            assert scfg.max_seq_len % self.page_size == 0, (
+                scfg.max_seq_len, self.page_size
+            )
+            self.max_pages = scfg.max_seq_len // self.page_size
+            num_pages = scfg.num_pages or (
+                scfg.max_batch * self.max_pages + RESERVED_PAGES
+            )
+            self.cache = PagedKVCache(
+                len(cfg.attn_layer_idx),
+                num_pages,
+                self.page_size,
+                a.num_heads_kv,
+                a.head_dim,
+                dtype=self.compute_dtype,
+                quant="none",
+            )
+        self.tune_how = "n/a"
+
+        # the whole fleet of slots steps as one fixed-shape batch: one
+        # slab covering max_batch streams, donated through the jit so
+        # the update is in-place
+        self._state = init_mamba_decode_state(
+            cfg, scfg.max_batch, self.compute_dtype
+        )
+        self._prefill_cache: Dict = {}
+        self._table_key = None
+        self._table_dev = None
+
+        def _mask_state(new, old, live):
+            return jax.tree.map(
+                lambda n, o: jnp.where(
+                    live.reshape((o.shape[0],) + (1,) * (n.ndim - 1)), n, o
+                ),
+                new,
+                old,
+            )
+
+        if self._hybrid:
+            page_size = self.page_size
+
+            def _step(params, state, pools, page_table, seq_lens, tokens,
+                      key):
+                logits, new_state, pools = mamba_decode_step(
+                    params, state, pools, page_table, seq_lens, tokens,
+                    cfg, page_size=page_size,
+                    compute_dtype=self.compute_dtype,
+                )
+                # idle rows (lens 0 — a prompt is never empty) must not
+                # smear garbage into released, zeroed slab slices
+                state = _mask_state(new_state, state, seq_lens > 0)
+                tok = sample_token(
+                    logits, key, scfg.temperature, scfg.top_k,
+                    scfg.do_sample,
+                )
+                return tok.astype(jnp.int32), logits, state, pools
+
+            self._decode_fn = jax.jit(_step, donate_argnums=(1, 2))
+        else:
+
+            def _step(params, state, seq_lens, tokens, key):
+                logits, new_state, _ = mamba_decode_step(
+                    params, state, None, None, seq_lens, tokens,
+                    cfg, compute_dtype=self.compute_dtype,
+                )
+                state = _mask_state(new_state, state, seq_lens > 0)
+                tok = sample_token(
+                    logits, key, scfg.temperature, scfg.top_k,
+                    scfg.do_sample,
+                )
+                return tok.astype(jnp.int32), logits, state
+
+            self._decode_fn = jax.jit(_step, donate_argnums=(1,))
+
+    # -- capacity ----------------------------------------------------------
+
+    def _padded(self, n: int) -> int:
+        return self._padded_len(n, self.scfg.prefill_bucket)
+
+    def admission_error(self, prompt_len: int, max_new: int) -> Optional[str]:
+        if not self._hybrid:
+            return None  # constant slab: fits iff a slot exists
+        worst = self._padded(prompt_len + max_new - 1) + 1
+        need = self.cache.pages_needed(worst)
+        total = self.cache.num_pages - RESERVED_PAGES
+        if need > total:
+            return (
+                f"request needs up to {need} attn pages but the pool "
+                f"holds {total}; raise num_pages or shrink "
+                f"prompt/max_new_tokens"
+            )
+        return None
+
+    def can_admit(self, rid: int, prompt_len: int) -> bool:
+        if not self._hybrid:
+            return True
+        return self.cache.can_ensure(rid, self._padded(prompt_len) + 1)
+
+    def grow(self, rid: int, n_tokens: int) -> bool:
+        if not self._hybrid:
+            return True
+        return self.cache.ensure(rid, n_tokens)
+
+    def release(self, rid: int, slot: int) -> None:
+        # zero the slab slice: an idle slot must hold no residue of the
+        # evicted stream (and the decode step's live-mask keeps it zero)
+        self._state = jax.tree.map(
+            lambda s: s.at[slot].set(0), self._state
+        )
+        if self._hybrid:
+            self.cache.free(rid)
+
+    # -- prefill -----------------------------------------------------------
+
+    def _get_prefill(self, p_pad: int, kv_len: int):
+        key = (p_pad, kv_len)
+        fn = self._prefill_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                partial(
+                    mamba_prefill,
+                    cfg=self.model_cfg,
+                    compute_dtype=self.compute_dtype,
+                    kv_len=kv_len,
+                )
+            )
+            self._prefill_cache[key] = fn
+        return fn
+
+    def prefill(self, rid: int, slot: int, prompt):
+        p = len(prompt)
+        p_pad = self._padded(p)
+        kv_len = 0
+        if self._hybrid:
+            kv_len = self.cache.pages_needed(p_pad) * self.page_size
+            ok = self.cache.ensure(rid, p_pad)
+            assert ok, "admission checked capacity; ensure cannot fail here"
+        toks = np.zeros((1, p_pad), np.int32)
+        toks[0, :p] = prompt
+        logits, st1, kv = self._get_prefill(p_pad, kv_len)(
+            self.params, jnp.asarray(toks), jnp.asarray([p], np.int32)
+        )
+        # land the 1-row prefill state in the stream's slab slice
+        self._state = jax.tree.map(
+            lambda s, n: s.at[slot].set(n[0]), self._state, st1
+        )
+        if self._hybrid:
+            self.cache.write_prompt(rid, kv["k"][:, 0], kv["v"][:, 0])
+        # prefill already selects each row's last real position
+        return logits[0]
+
+    # -- decode ------------------------------------------------------------
+
+    def decode(self, slot_rids, lens, tokens, key):
+        if not self._hybrid:
+            toks, logits, self._state = self._decode_fn(
+                self.params,
+                self._state,
+                jnp.asarray(lens),
+                jnp.asarray(tokens),
+                key,
+            )
+            return np.asarray(toks), logits
+        tkey = (self.cache.table_version, tuple(slot_rids))
+        if tkey != self._table_key:
+            self._table_key = tkey
+            self._table_dev = jnp.asarray(
+                self.cache.page_table(list(slot_rids), self.max_pages)
+            )
+        toks, logits, self._state, pools = self._decode_fn(
+            self.params,
+            self._state,
+            self.cache.pools,
+            self._table_dev,
+            jnp.asarray(lens),
+            jnp.asarray(tokens),
+            key,
+        )
+        self.cache.pools = pools
+        return np.asarray(toks), logits
+
+    # -- obs ---------------------------------------------------------------
+
+    @property
+    def state_bytes_per_stream(self) -> int:
+        return mamba_state_bytes_per_stream(
+            self.model_cfg, self.compute_dtype
+        )
+
+    def slab_slice(self, slot: int):
+        """The slot's slab (debug/tests): list over layers of {"conv",
+        "ssd"} rows ({} for hybrid attn layers)."""
+        return jax.tree.map(lambda s: s[slot], self._state)

@@ -1,0 +1,194 @@
+"""Mixtral family adapter: paged-KV attention + expert-routed FFN.
+
+The attention half is llama's paged path over mixtral's GQA shapes —
+same PagedKVCache, same page accounting, same zero-page bit-parity
+argument. The FFN half routes each decoded token through its top-k
+experts (models/mixtral.py::_moe_token): ``moe_impl="routed"`` gathers
+just the chosen experts' weights (the serving default — O(top_k/E) of
+the dense FLOPs), ``"dense"`` replays the training-path dense mix
+bit-for-bit. Both compute the same mixture: non-chosen experts carry
+exactly-zero mix weights and two-term fp32 addition is commutative, but
+the gathered per-token einsum lowers to a different dot-general than
+the dense all-experts matmul, so routed sits one ulp (~1e-10) off dense
+rather than bitwise on it. tests/test_serving_families.py pins both
+facts: dense decode == jitted dense forward walk bit-for-bit, routed ==
+dense token-for-token with single-ulp logits.
+"""
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fms_fsdp_tpu.models.generation import sample_token
+from fms_fsdp_tpu.models.mixtral import (
+    mixtral_paged_decode_step,
+    mixtral_prefill,
+)
+from fms_fsdp_tpu.serve.families import FamilyAdapter
+from fms_fsdp_tpu.serve.kv_cache import RESERVED_PAGES, PagedKVCache
+
+
+class MixtralAdapter(FamilyAdapter):
+    family = "mixtral"
+
+    def __init__(self, params, model_cfg, scfg, compute_dtype=None):
+        from fms_fsdp_tpu.serve.engine import _DTYPES
+        from fms_fsdp_tpu.tune.lookup import resolve_paged_decode
+
+        self.params = params
+        self.model_cfg = model_cfg
+        self.scfg = scfg
+        self.compute_dtype = compute_dtype or _DTYPES[scfg.compute_dtype]
+        self.moe_impl = moe_impl = getattr(scfg, "moe_impl", "routed")
+        if moe_impl not in ("routed", "dense"):
+            raise ValueError(
+                f"unknown moe_impl {moe_impl!r}: mixtral decode supports "
+                "'routed' (top-k gather) or 'dense' (training-path full "
+                "mixture, the strict bit-parity mode)"
+            )
+        cfg = model_cfg
+
+        if scfg.attn_impl == "kernel":
+            raise ValueError(
+                "mixtral serving decodes attention through the reference "
+                "gqa_attend for now: set attn_impl to 'auto' or "
+                "'reference' (the ragged kernel is llama-only in v1)"
+            )
+        if scfg.kv_quant != "none":
+            raise ValueError(
+                "mixtral serving stores attn pages full-width in v1: "
+                "set kv_quant='none'"
+            )
+        self.attn_impl = "reference"
+
+        nlayers = int(params["layers"]["wq"].shape[0])
+        page_size, self.block_kv, self.tune_how = resolve_paged_decode(
+            scfg.max_batch,
+            cfg.nheads,
+            cfg.n_kv_heads,
+            cfg.head_dim,
+            scfg.max_seq_len,
+            scfg.compute_dtype,
+            requested_page_size=scfg.page_size or None,
+        )
+        assert scfg.max_seq_len % page_size == 0, (
+            scfg.max_seq_len, page_size
+        )
+        self.page_size = page_size
+        self.max_pages = scfg.max_seq_len // page_size
+        num_pages = scfg.num_pages or (
+            scfg.max_batch * self.max_pages + RESERVED_PAGES
+        )
+        self.cache = PagedKVCache(
+            nlayers,
+            num_pages,
+            page_size,
+            cfg.n_kv_heads,
+            cfg.head_dim,
+            dtype=self.compute_dtype,
+            quant="none",
+        )
+        self._prefill_cache: Dict = {}
+        self._table_key = None
+        self._table_dev = None
+
+        def _step(params, pools, page_table, seq_lens, tokens, key):
+            logits, pools = mixtral_paged_decode_step(
+                params,
+                pools,
+                page_table,
+                seq_lens,
+                tokens,
+                cfg,
+                page_size=page_size,
+                compute_dtype=self.compute_dtype,
+                moe_impl=moe_impl,
+            )
+            tok = sample_token(
+                logits, key, scfg.temperature, scfg.top_k, scfg.do_sample
+            )
+            return tok.astype(jnp.int32), logits, pools
+
+        self._decode_fn = jax.jit(_step, donate_argnums=(1,))
+
+    # -- capacity (same page math as llama) --------------------------------
+
+    def _padded(self, n: int) -> int:
+        return self._padded_len(n, self.scfg.prefill_bucket)
+
+    def admission_error(self, prompt_len: int, max_new: int) -> Optional[str]:
+        worst = self._padded(prompt_len + max_new - 1) + 1
+        need = self.cache.pages_needed(worst)
+        total = self.cache.num_pages - RESERVED_PAGES
+        if need > total:
+            return (
+                f"request needs up to {need} pages but the pool holds "
+                f"{total}; raise num_pages or shrink "
+                f"prompt/max_new_tokens"
+            )
+        return None
+
+    def can_admit(self, rid: int, prompt_len: int) -> bool:
+        return self.cache.can_ensure(rid, self._padded(prompt_len) + 1)
+
+    def grow(self, rid: int, n_tokens: int) -> bool:
+        return self.cache.ensure(rid, n_tokens)
+
+    def release(self, rid: int, slot: int) -> None:
+        self.cache.free(rid)
+
+    # -- prefill -----------------------------------------------------------
+
+    def _get_prefill(self, p_len: int, s_pad: int, full_logits: bool):
+        key = (p_len, s_pad, full_logits)
+        fn = self._prefill_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                partial(
+                    mixtral_prefill,
+                    cfg=self.model_cfg,
+                    max_seq_len=s_pad,
+                    compute_dtype=self.compute_dtype,
+                    full_logits=full_logits,
+                )
+            )
+            self._prefill_cache[key] = fn
+        return fn
+
+    def prefill(self, rid: int, slot: int, prompt):
+        p = len(prompt)
+        p_pad = self._padded(p)
+        s_pad = self.cache.pages_needed(p_pad) * self.page_size
+        ok = self.cache.ensure(rid, p_pad)
+        assert ok, "admission checked capacity; ensure cannot fail here"
+        toks = np.zeros((1, p_pad), np.int32)
+        toks[0, :p] = prompt
+        full_logits = p_pad != p
+        logits, _, kv = self._get_prefill(p_pad, s_pad, full_logits)(
+            self.params, jnp.asarray(toks)
+        )
+        self.cache.write_prompt(rid, kv["k"][:, 0], kv["v"][:, 0])
+        return logits[0, p - 1] if full_logits else logits[0, 0]
+
+    # -- decode ------------------------------------------------------------
+
+    def decode(self, slot_rids, lens, tokens, key):
+        tkey = (self.cache.table_version, tuple(slot_rids))
+        if tkey != self._table_key:
+            self._table_key = tkey
+            self._table_dev = jnp.asarray(
+                self.cache.page_table(list(slot_rids), self.max_pages)
+            )
+        toks, logits, pools = self._decode_fn(
+            self.params,
+            self.cache.pools,
+            self._table_dev,
+            jnp.asarray(lens),
+            jnp.asarray(tokens),
+            key,
+        )
+        self.cache.pools = pools
+        return np.asarray(toks), logits

@@ -67,21 +67,23 @@ def build_engine(args):
     arg parser) without jax."""
     import jax
 
-    from fms_fsdp_tpu.models.configs import LlamaConfig
-    from fms_fsdp_tpu.models.llama import init_llama_params
     from fms_fsdp_tpu.serve.engine import ServeConfig, ServingEngine
+    from fms_fsdp_tpu.serve.families import init_params_for, load_model_config
 
+    # model construction resolves through the family registry
+    # (serve/families/) — the same resolution the engine itself uses, so
+    # replica and engine can never diverge on it (a llama bootstrap used
+    # to be duplicated here); model_cfg.json may carry any family, with
+    # an optional explicit "family" key
     with open(args.model_cfg) as f:
-        model_cfg = LlamaConfig(**json.load(f))
+        model_cfg = load_model_config(json.load(f))
     with open(args.serve_cfg) as f:
         serve_cfg = ServeConfig(**json.load(f))
     if args.params:
         return ServingEngine.from_checkpoint(
             args.params, model_cfg, serve_cfg
         )
-    params = init_llama_params(
-        jax.random.PRNGKey(args.init_seed), model_cfg
-    )
+    params = init_params_for(model_cfg)(jax.random.PRNGKey(args.init_seed))
     return ServingEngine(params, model_cfg, serve_cfg)
 
 
@@ -218,7 +220,9 @@ def serve_loop(engine, replica_idx: int, idle_sleep_s: float = 0.02):
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model-cfg", required=True,
-                    help="JSON file of LlamaConfig fields")
+                    help="JSON file of model-config fields; family "
+                         "inferred from the keys or pinned by an "
+                         "explicit \"family\" entry (serve/families/)")
     ap.add_argument("--serve-cfg", required=True,
                     help="JSON file of ServeConfig fields")
     ap.add_argument("--params", default="",

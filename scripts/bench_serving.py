@@ -10,6 +10,10 @@ completion — and reports the three serving headline numbers:
   which is exactly what the metric is for);
 - ``p99_latency_s``: p99 end-to-end request latency.
 
+The default run emits one steady-state row per model family
+(llama / mamba / mixtral — serve/families/), each carrying ``family``
+and ``state_bytes_per_stream`` (mamba's constant decode slab; 0 for
+paged-KV-only families); ``--family X`` benches one family alone.
 Every row additionally reports per-request ``availability``
 (completed / submitted), so the steady-state rows and the
 ``fleet-under-churn`` row (a 2-replica fleet with one replica
@@ -54,6 +58,7 @@ _REQUIRED = {
     "p99_latency_s": (int, float),
 }
 _ROW_REQUIRED = {
+    "family": str,
     "max_batch": int,
     "requests": int,
     "prompt_len": int,
@@ -67,6 +72,9 @@ _ROW_REQUIRED = {
     "requests_completed": int,
     "requests_evicted": int,
     "kv_pages_peak": int,
+    # decode-state slab bytes one stream holds (mamba's constant-memory
+    # number; 0 for families whose whole state is paged KV)
+    "state_bytes_per_stream": (int, float),
     # per-request availability = completed / submitted, on EVERY row —
     # steady-state rows and the fleet-under-churn row share one
     # schema. Under churn the fleet's zero-drop contract keeps this at
@@ -111,6 +119,7 @@ def _zero_doc():
     """A schema-shaped all-zero document (the --dry-run artifact)."""
     row = {k: (0 if t is int else 0.0) for k, t in _ROW_REQUIRED.items()}
     row.update(
+        family="llama",
         kv_quant="none",
         ttft_s={"mean": 0.0, "p50": 0.0, "p99": 0.0},
     )
@@ -140,9 +149,8 @@ def run_row(params, cfg, max_batch, n_requests, prompt_len, max_new,
     )
     eng = ServingEngine(params, cfg, scfg)
     rng = np.random.default_rng(0)
-    prompts = rng.integers(
-        0, cfg.src_vocab_size, size=(n_requests, prompt_len)
-    )
+    vocab = getattr(cfg, "src_vocab_size", None) or cfg.vocab_size
+    prompts = rng.integers(0, vocab, size=(n_requests, prompt_len))
     # warmup wave: compiles prefill + decode; the wall/token accounting
     # is reset after so compile time never pollutes the measured rate
     for p in prompts:
@@ -154,13 +162,14 @@ def run_row(params, cfg, max_batch, n_requests, prompt_len, max_new,
     pages_peak = 0
     while eng.has_work():
         eng.step()
-        pages_peak = max(pages_peak, eng.cache.pages_in_use)
+        pages_peak = max(pages_peak, eng.adapter.pages_in_use)
     ttfts = [r.ttft for r in reqs if r.ttft is not None]
     lats = [r.latency for r in reqs if r.latency is not None]
     tok_s = (
         eng._decode_tokens / eng._decode_wall if eng._decode_wall else 0.0
     )
     return {
+        "family": eng.family,
         "max_batch": max_batch,
         "requests": n_requests,
         "prompt_len": prompt_len,
@@ -181,6 +190,7 @@ def run_row(params, cfg, max_batch, n_requests, prompt_len, max_new,
         "requests_completed": sum(r.state == "finished" for r in reqs),
         "requests_evicted": sum(r.evictions > 0 for r in reqs),
         "kv_pages_peak": int(pages_peak),
+        "state_bytes_per_stream": int(eng.adapter.state_bytes_per_stream),
         "availability": round(
             sum(r.state == "finished" for r in reqs) / max(1, len(reqs)),
             4,
@@ -251,6 +261,7 @@ def run_fleet_row(model_cfg_dict):
     completed = sum(r.state == "completed" for r in recs)
     return {
         "mode": "fleet-under-churn",
+        "family": "llama",
         "max_batch": BATCH,
         "requests": REQUESTS,
         "prompt_len": PROMPT,
@@ -268,12 +279,40 @@ def run_fleet_row(model_cfg_dict):
         "requests_completed": completed,
         "requests_evicted": 0,
         "kv_pages_peak": 0,
+        "state_bytes_per_stream": 0,
         "availability": round(completed / max(1, len(recs)), 4),
         "replica_availability": round(stats["availability"], 6),
         "replicas": int(stats["replicas"]),
         "restarts": int(stats["restarts"]),
         "requests_requeued": int(stats["requests_requeued"]),
     }
+
+
+def bench_model_cfg(family):
+    """The benchmark model for one family — comparable scale across
+    families (256-dim trunk, 4 layers, 512 vocab)."""
+    from fms_fsdp_tpu.models.configs import (
+        LlamaConfig,
+        MambaConfig,
+        MixtralConfig,
+    )
+
+    if family == "llama":
+        return LlamaConfig(
+            src_vocab_size=512, emb_dim=256, nheads=4, kvheads=2,
+            nlayers=4, max_expected_seq_len=SEQ,
+        )
+    if family == "mamba":
+        return MambaConfig(
+            d_model=256, n_layer=4, vocab_size=512, d_state=16,
+            headdim=64, chunk_size=16, attn_layer_idx=(),
+            d_intermediate=512,
+        )
+    assert family == "mixtral", family
+    return MixtralConfig(
+        src_vocab_size=512, emb_dim=256, nheads=4, kvheads=2, nlayers=4,
+        hidden_dim=512, num_experts=4, top_k=2, max_expected_seq_len=SEQ,
+    )
 
 
 def main():
@@ -283,7 +322,13 @@ def main():
                          "without importing jax (CI smoke)")
     ap.add_argument("--ckpt", default="",
                     help="serve params from this checkpoint instead of "
-                         "a random tiny-llama init")
+                         "a random tiny init (llama only)")
+    ap.add_argument("--family", default="all",
+                    choices=["all", "llama", "mamba", "mixtral"],
+                    help="bench one family's steady-state row only; "
+                         "'all' (the BENCH_SERVING.json shape) runs one "
+                         "row per family plus the llama int8 / "
+                         "oversubscribed / fleet-under-churn rows")
     args = ap.parse_args()
 
     if args.dry_run:
@@ -297,36 +342,47 @@ def main():
 
     import jax
 
-    from fms_fsdp_tpu.models.configs import LlamaConfig
-    from fms_fsdp_tpu.models.llama import init_llama_params
+    from fms_fsdp_tpu.serve.families import init_params_for
 
-    cfg = LlamaConfig(
-        src_vocab_size=512, emb_dim=256, nheads=4, kvheads=2, nlayers=4,
-        max_expected_seq_len=SEQ,
+    families = (
+        ["llama", "mamba", "mixtral"]
+        if args.family == "all" else [args.family]
     )
-    if args.ckpt:
-        from fms_fsdp_tpu.utils.checkpointing import load_params_only
+    cfgs, params = {}, {}
+    for fam in families:
+        cfgs[fam] = bench_model_cfg(fam)
+        if fam == "llama" and args.ckpt:
+            from fms_fsdp_tpu.utils.checkpointing import load_params_only
 
-        params = load_params_only(
-            args.ckpt, lambda k: init_llama_params(k, cfg)
-        )
-    else:
-        params = init_llama_params(jax.random.PRNGKey(0), cfg)
+            params[fam] = load_params_only(
+                args.ckpt, init_params_for(cfgs[fam])
+            )
+        else:
+            params[fam] = init_params_for(cfgs[fam])(jax.random.PRNGKey(0))
 
+    # one steady-state row per family: the cross-family headline
+    # (llama/mixtral pay paged KV per token; mamba's decode state is
+    # the constant slab the row's state_bytes_per_stream reports)
     rows = [
-        run_row(params, cfg, BATCH, REQUESTS, PROMPT, NEW),
-        # quantized page storage: the resident-KV-bytes lever
-        run_row(params, cfg, BATCH, REQUESTS, PROMPT, NEW,
-                kv_quant="int8"),
-        # oversubscribed: 2x the requests on the same batch — queue
-        # wait lands in TTFT, the continuous-batching stress shape
-        run_row(params, cfg, BATCH, 2 * REQUESTS, PROMPT, NEW),
-        # 2-replica fleet with one replica killed mid-stream: the
-        # serving numbers under churn (docs/serving.md "Fleet
-        # resilience"; the same schedule scripts/chaos_soak_serving.py
-        # asserts zero-drop token parity on)
-        run_fleet_row(dataclasses.asdict(cfg)),
+        run_row(params[f], cfgs[f], BATCH, REQUESTS, PROMPT, NEW)
+        for f in families
     ]
+    if args.family == "all":
+        cfg, p = cfgs["llama"], params["llama"]
+        rows += [
+            # quantized page storage: the resident-KV-bytes lever
+            run_row(p, cfg, BATCH, REQUESTS, PROMPT, NEW,
+                    kv_quant="int8"),
+            # oversubscribed: 2x the requests on the same batch — queue
+            # wait lands in TTFT, the continuous-batching stress shape
+            run_row(p, cfg, BATCH, 2 * REQUESTS, PROMPT, NEW),
+            # 2-replica fleet with one replica killed mid-stream: the
+            # serving numbers under churn (docs/serving.md "Fleet
+            # resilience"; the same schedule
+            # scripts/chaos_soak_serving.py asserts zero-drop token
+            # parity on)
+            run_fleet_row(dataclasses.asdict(cfg)),
+        ]
     backend = jax.default_backend()
     result = {
         "metric": "serving engine throughput/latency",

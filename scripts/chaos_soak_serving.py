@@ -5,7 +5,12 @@ availability < 1.0.
 Three fleet runs over the SAME seeded request wave against the same
 deterministically-initialized tiny model (greedy, reference attention,
 float32 — the bit-parity mode PR 11's anchor proved batch-composition-
-independent, which is what makes cross-run token comparison exact):
+independent, which is what makes cross-run token comparison exact).
+``--family mamba`` swaps the fleet model for a hybrid mamba (conv+SSD
+slab decode, one attn layer on pages — serve/families/): a requeued
+request's recompute-on-resume must then rebuild the recurrent slab
+from scratch, so the token-parity assertion doubles as the fleet-level
+proof of that family's eviction contract. The runs:
 
 1. **reference**: no faults — the parity baseline;
 2. **kill**: ``replica_kill`` hard-exits replica 1 mid-stream (engine
@@ -26,7 +31,7 @@ divergent recompute), the restart ledger shows >= 1 relaunch with
 MEASURED < 1.0 (the churn happened) while per-request completion stays
 1.0 (nothing was dropped). The stall run must additionally detect >= 1
 stall via the watchdog. The fleet stats map is validated against the
-obs schema v11 ``serving_fleet`` field.
+obs schema ``serving_fleet`` field (v12).
 
 Writes ``fleet_soak.json`` (summary) plus per-incarnation replica
 stderr logs and the request journal / restart ledger under ``--out``.
@@ -53,14 +58,41 @@ from fms_fsdp_tpu.serve.fleet import (  # noqa: E402
     make_subprocess_spawn,
 )
 
-MODEL_CFG = {
-    "src_vocab_size": 128,
-    "emb_dim": 64,
-    "nheads": 4,
-    "kvheads": 2,
-    "nlayers": 2,
-    "max_expected_seq_len": 128,
+# per-family fleet model (--family): the replica resolves it through
+# serve/families.load_model_config, so the same soak drives a llama
+# fleet (paged KV only) or a hybrid-mamba fleet (conv+SSD slab decode
+# with one attn layer on pages) — eviction/requeue recompute must
+# rebuild the slab from scratch, so token parity here is the fleet-level
+# proof of the family's recompute-on-resume contract.
+MODEL_CFGS = {
+    "llama": {
+        "src_vocab_size": 128,
+        "emb_dim": 64,
+        "nheads": 4,
+        "kvheads": 2,
+        "nlayers": 2,
+        "max_expected_seq_len": 128,
+    },
+    "mamba": {
+        "family": "mamba",
+        "d_model": 64,
+        "n_layer": 3,
+        "vocab_size": 128,
+        "d_state": 16,
+        "headdim": 16,
+        "chunk_size": 8,
+        "d_intermediate": 128,
+        "attn_layer_idx": [1],
+        "attn_cfg": {
+            "head_dim": 16,
+            "num_heads": 4,
+            "num_heads_kv": 2,
+            "rotary_emb_dim": 8,
+        },
+    },
 }
+MODEL_CFG = MODEL_CFGS["llama"]  # --family rebinds
+FAMILY = "llama"
 SERVE_CFG = {
     "max_batch": 4,
     "max_seq_len": 128,
@@ -77,6 +109,9 @@ SERVE_CFG = {
 N_REQUESTS = int(os.environ.get("FLEET_SOAK_REQUESTS", "10"))
 MAX_NEW = 8
 SEED = 0
+# the mamba prefill scan compiles slower than llama's on CPU; keep the
+# watchdog above a residual mid-run compile for that family
+STALL_TIMEOUT_S = {"llama": 10.0, "mamba": 30.0}
 
 
 def make_wave(n, seed):
@@ -84,12 +119,11 @@ def make_wave(n, seed):
     import numpy as np
 
     rng = np.random.default_rng(seed)
+    vocab = MODEL_CFG.get("src_vocab_size") or MODEL_CFG["vocab_size"]
     wave = []
     for _ in range(n):
         plen = int(rng.integers(6, 17))
-        wave.append(
-            rng.integers(0, MODEL_CFG["src_vocab_size"], size=plen).tolist()
-        )
+        wave.append(rng.integers(0, vocab, size=plen).tolist())
     return wave
 
 
@@ -111,7 +145,7 @@ def run_fleet(tag, workdir, faults=""):
         max_inflight_per_replica=4,
         # above the worst single-step wall on CPU (a residual jit
         # compile), far below the injected 600s stall
-        stall_timeout_s=10.0,
+        stall_timeout_s=STALL_TIMEOUT_S[FAMILY],
         startup_timeout_s=180.0,
         restart_backoff_s=0.2,
         journal_path=os.path.join(wdir, "journal.jsonl"),
@@ -169,8 +203,8 @@ def assert_faulted(tag, ref_tokens, tokens, stats, ledger):
 
 
 def validate_obs_map(stats):
-    """The fleet stats map must satisfy the obs v11 serving_fleet
-    field on a schema-valid record."""
+    """The fleet stats map must satisfy the obs serving_fleet field on
+    a schema-valid record (v12)."""
     from fms_fsdp_tpu.obs.schema import (
         SCHEMA_FIELDS,
         SCHEMA_VERSION,
@@ -189,13 +223,20 @@ def validate_obs_map(stats):
 
 
 def main():
+    global MODEL_CFG, FAMILY
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default="",
                     help="artifact dir (default: a temp dir)")
+    ap.add_argument("--family", default="llama",
+                    choices=sorted(MODEL_CFGS),
+                    help="fleet model family: llama (paged KV) or "
+                         "hybrid mamba (slab + one attn layer)")
     args = ap.parse_args()
-    out = args.out or tempfile.mkdtemp(prefix="fleet_soak_")
+    MODEL_CFG = MODEL_CFGS[args.family]
+    FAMILY = args.family
+    out = args.out or tempfile.mkdtemp(prefix=f"fleet_soak_{FAMILY}_")
     os.makedirs(out, exist_ok=True)
-    print(f"serving chaos soak -> {out}")
+    print(f"serving chaos soak ({FAMILY} fleet) -> {out}")
 
     ref_tokens, ref_stats, _, ref_wall = run_fleet("reference", out)
     assert ref_stats["restarts"] == 0, "reference run must be unfaulted"
@@ -226,6 +267,7 @@ def main():
     validate_obs_map(kill_stats)
 
     summary = {
+        "family": FAMILY,
         "requests": N_REQUESTS,
         "reference": {"wall_s": round(ref_wall, 2), **ref_stats},
         "kill": kill_stats,
