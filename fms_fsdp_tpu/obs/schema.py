@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -114,6 +114,12 @@ SCHEMA_FIELDS = {
     # as its code) — and ``state_bytes_per_stream``, the decode-state
     # slab bytes one stream holds (mamba's constant-memory headline;
     # 0.0 for families whose whole decode state is paged KV).
+    # v13: the map gains the disaggregation + layout fields (docs/
+    # observability.md "v13"): ``role`` (serve/disagg ROLE_CODES:
+    # 0=unified 1=prefill 2=decode), ``serve_layout`` (100*tp + fsdp,
+    # 0 = single-chip; parallel/sharding.py::serve_layout_code),
+    # ``handoff_bytes`` (cumulative PageHandoff wire bytes packed +
+    # imported) and ``handoff_s`` (wall seconds packing/scattering).
     "serving": ("map", False),
     # v11: serving-fleet accounting (docs/serving.md "Fleet
     # resilience"). Flat map from FleetRouter.stats(): replicas /
@@ -203,6 +209,12 @@ SCHEMA_DIGESTS = {
     # serve/families.FAMILY_CODES) + state_bytes_per_stream (constant
     # decode-slab bytes; the field set itself is unchanged)
     12: "30df6d1be6e3214a083627b8cbb8a765d7c7e51aef6bdf4eca8fe469d13e5881",
+    # v13: serving map gains role (disagg ROLE_CODES), serve_layout
+    # (100*tp + fsdp layout code), handoff_bytes and handoff_s (the
+    # PageHandoff wire traffic; field set itself unchanged), and the
+    # serving_fleet map gains prefill_replicas / requests_handed_off /
+    # handoff_bytes
+    13: "598cbb44447e0667b8655a5b06dc569b2e00b33f748561f2d2ec6d365600418d",
 }
 
 

@@ -292,6 +292,118 @@ def quantized_grad_reduce(grads, mode: str, quant_state=None):
     return grads, {"amax_history": new_hist}
 
 
+# ---------------------------------------------------------------------------
+# serving layout (ServeConfig.serve_layout — docs/serving.md "Sharded
+# replicas & disaggregation")
+# ---------------------------------------------------------------------------
+
+# a serving replica's mesh carries only the two axes serving shards
+# over: fsdp (ZeRO-style weight sharding) and tensor (megatron TP over
+# heads/ffn). The train-side spec rulebooks (llama_param_specs,
+# mixtral_param_specs) never name any other axis on a weight, so
+# resolve_spec consumes them on this submesh unchanged — one rulebook,
+# train and serve.
+SERVE_MESH_AXES = (AXIS_FSDP, AXIS_TENSOR)
+
+
+def parse_serve_layout(layout: str) -> Dict[str, int]:
+    """``"tp=2"`` / ``"tp=2,fsdp=2"`` -> {"tensor": 2, "fsdp": 2}.
+
+    Empty string means single-chip (the caller skips mesh construction
+    entirely — every existing parity anchor runs that path untouched).
+    Unknown keys and non-positive extents are typed config errors."""
+    out = {AXIS_TENSOR: 1, AXIS_FSDP: 1}
+    if not layout:
+        return out
+    names = {"tp": AXIS_TENSOR, "tensor": AXIS_TENSOR, "fsdp": AXIS_FSDP}
+    for part in layout.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        axis = names.get(key.strip())
+        if axis is None:
+            raise ValueError(
+                f"unknown serve_layout axis {key.strip()!r} in "
+                f"{layout!r}: expected 'tp' and/or 'fsdp' "
+                f"(e.g. \"tp=2\" or \"tp=2,fsdp=2\")"
+            )
+        try:
+            extent = int(val)
+        except ValueError:
+            extent = 0
+        if extent <= 0:
+            raise ValueError(
+                f"serve_layout axis {key.strip()!r} needs a positive "
+                f"integer extent, got {val!r} in {layout!r}"
+            )
+        out[axis] = extent
+    return out
+
+
+def serve_layout_code(layout: str) -> int:
+    """Numeric shard-layout code for flat str->number obs maps (schema
+    v13 ``serving.serve_layout``): ``100 * tp + fsdp``, 0 for the
+    single-chip layout (no mesh)."""
+    if not layout:
+        return 0
+    ext = parse_serve_layout(layout)
+    return 100 * ext[AXIS_TENSOR] + ext[AXIS_FSDP]
+
+
+def build_serve_mesh(layout: str, devices=None) -> Optional[Mesh]:
+    """``serve_layout`` string -> the replica's 2-axis serving mesh
+    (None for the single-chip layout). Uses the first tp*fsdp visible
+    devices; fewer than that is a hard config error — a sharded replica
+    that silently ran single-chip would misreport its capacity to the
+    fleet router."""
+    ext = parse_serve_layout(layout)
+    n = ext[AXIS_FSDP] * ext[AXIS_TENSOR]
+    if n <= 1:
+        return None
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n:
+        raise ValueError(
+            f"serve_layout {layout!r} needs {n} devices "
+            f"(fsdp={ext[AXIS_FSDP]} x tensor={ext[AXIS_TENSOR]}) but "
+            f"only {len(devices)} are visible"
+        )
+    arr = np.asarray(devices[:n]).reshape(
+        ext[AXIS_FSDP], ext[AXIS_TENSOR]
+    )
+    return Mesh(arr, SERVE_MESH_AXES)
+
+
+def serve_kv_pool_specs(quant: str = "none") -> Dict[str, P]:
+    """PartitionSpecs for the PagedKVCache pools on a serving mesh:
+    (L, P, page_size, Nkv, H) pools shard the kv-head dim over the
+    tensor axis — the same placement the train-side cache uses, and the
+    layout *Ragged Paged Attention* (PAPERS.md) serves from. Scale
+    pools (quantized storage) are (L, P, page_size, Nkv, 1) and shard
+    identically. resolve_spec drops the entry when Nkv does not divide
+    tp, so tiny debug models stay replicated instead of failing."""
+    spec = P(None, None, None, AXIS_TENSOR, None)
+    out = {"k": spec, "v": spec}
+    if quant != "none":
+        out["k_scale"] = spec
+        out["v_scale"] = spec
+    return out
+
+
+def serve_param_specs(family: str):
+    """Family -> the param spec rulebook a sharded serving replica
+    places weights with (None = replicate every leaf). Mamba has no
+    rulebook yet — its adapter rejects serve_layout with the fix
+    spelled out, so this never resolves for it."""
+    if family == "llama":
+        return llama_param_specs(scan=True)
+    if family == "mixtral":
+        from fms_fsdp_tpu.models.mixtral import mixtral_param_specs
+
+        return mixtral_param_specs(scan=True)
+    return None
+
+
 def shard_params(params, specs, mesh: Mesh):
     """Place a param pytree on the mesh per the spec tree (host -> device).
 

@@ -34,6 +34,12 @@ _LAZY = {
     "init_params_for": "fms_fsdp_tpu.serve.families",
     "load_model_config": "fms_fsdp_tpu.serve.families",
     "resolve_adapter": "fms_fsdp_tpu.serve.families",
+    # disaggregation (serve/disagg/): the handoff codec is jax-free
+    # (numpy + stdlib), lazy only to keep serve import light
+    "HandoffError": "fms_fsdp_tpu.serve.disagg",
+    "ROLE_CODES": "fms_fsdp_tpu.serve.disagg",
+    "pack_handoff": "fms_fsdp_tpu.serve.disagg",
+    "unpack_handoff": "fms_fsdp_tpu.serve.disagg",
 }
 
 __all__ = [
@@ -42,8 +48,12 @@ __all__ = [
     "FamilyAdapter",
     "FleetConfig",
     "FleetRouter",
+    "HandoffError",
     "PagedKVCache",
+    "ROLE_CODES",
     "ReplicaLostError",
+    "pack_handoff",
+    "unpack_handoff",
     "Request",
     "RequestJournal",
     "RequestRejected",

@@ -1,0 +1,43 @@
+"""Disaggregated serving: prefill/decode role split + KV-page handoff.
+
+The pieces (docs/serving.md "Sharded replicas & disaggregation"):
+
+- :mod:`~fms_fsdp_tpu.serve.disagg.handoff` — the PageHandoff codec
+  (deterministic wire bytes for a sequence's KV pages + sampling
+  state);
+- ``ServeConfig.role`` (serve/engine.py) — what an engine does with an
+  admitted request: ``unified`` serves end-to-end, ``prefill`` packs a
+  handoff after the first token, ``decode`` additionally accepts
+  ``submit_handoff`` resumes;
+- ``FleetConfig.prefill_replicas`` (serve/fleet.py) — the router-side
+  topology: the first K replica indices are prefill workers, the rest
+  decode replicas, with the handoff journaled in between.
+
+Role codes mirror FAMILY_CODES: flat numeric obs maps (schema v13
+``serving.role``) carry ROLE_CODES[name].
+"""
+
+from fms_fsdp_tpu.serve.disagg.handoff import (
+    HandoffError,
+    WIRE_VERSION,
+    pack_handoff,
+    unpack_handoff,
+)
+
+ROLE_UNIFIED = "unified"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLES = (ROLE_UNIFIED, ROLE_PREFILL, ROLE_DECODE)
+ROLE_CODES = {ROLE_UNIFIED: 0, ROLE_PREFILL: 1, ROLE_DECODE: 2}
+
+__all__ = [
+    "HandoffError",
+    "ROLES",
+    "ROLE_CODES",
+    "ROLE_DECODE",
+    "ROLE_PREFILL",
+    "ROLE_UNIFIED",
+    "WIRE_VERSION",
+    "pack_handoff",
+    "unpack_handoff",
+]

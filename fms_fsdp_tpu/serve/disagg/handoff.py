@@ -1,0 +1,153 @@
+"""PageHandoff: deterministic wire bytes for a sequence's decode state.
+
+Disaggregated serving (docs/serving.md "Sharded replicas &
+disaggregation") splits a request across two fault domains: a prefill
+worker computes the prompt's KV pages and first token, then the decode
+replica continues the stream. What crosses the wire is exactly the
+sequence's restartable state:
+
+- the KV pages, in the pool's STORAGE dtype — int8/fp8 pages ship as
+  their 1-byte values plus the fp32 scale leaves, never dequantized or
+  widened (the whole point of quantized pools is the wire/HBM bytes);
+- the sampling state: prompt tokens, tokens generated so far (the
+  prefill's first token), the sequence length and the allocator's token
+  accounting, so the receiving pool reconstructs the exact allocation.
+
+Wire format (version 1, little-endian)::
+
+    b"FMSH" | u16 version | u32 header_len | header JSON (canonical)
+    | leaf bytes, in the header's leaf order | u32 crc32(everything
+    before it)
+
+Determinism contract (pinned by tests/test_disagg.py): the header JSON
+is canonical (sorted keys, no whitespace), leaf order is the sorted
+leaf-name order recorded in the header, and leaf bytes are the C-order
+``tobytes`` of each array — two processes packing the same state emit
+identical bytes. The trailing CRC turns a torn/corrupt transfer into a
+typed :class:`HandoffError` at unpack instead of silent garbage pages;
+the fleet router treats that like any replica-side rejection and the
+journal requeues the request exactly-once.
+
+This module is jax-free (numpy + ml_dtypes, both already jax
+dependencies): the router relays handoffs as opaque base64 and only the
+two engines ever pack/unpack, but keeping the codec importable without
+jax lets tests and tooling inspect wire bytes on thin hosts.
+"""
+
+import json
+import struct
+import zlib
+from typing import Dict, Tuple
+
+import numpy as np
+
+MAGIC = b"FMSH"
+WIRE_VERSION = 1
+
+# storage dtypes a pool leaf may ship in. bf16/fp8 resolve through
+# ml_dtypes (the numpy-side registration jax itself uses).
+_DTYPES = {
+    "float32": np.dtype(np.float32),
+    "float16": np.dtype(np.float16),
+    "int8": np.dtype(np.int8),
+}
+try:  # pragma: no cover - import guard, always present under jax
+    import ml_dtypes
+
+    _DTYPES["bfloat16"] = np.dtype(ml_dtypes.bfloat16)
+    _DTYPES["float8_e4m3fn"] = np.dtype(ml_dtypes.float8_e4m3fn)
+except ImportError:  # pragma: no cover
+    pass
+
+
+class HandoffError(ValueError):
+    """A handoff that cannot be applied: torn/corrupt wire bytes, a
+    wire-version we do not speak, or pages packed for a different pool
+    shape/quant than the receiving replica's. Typed so the replica can
+    reject it back to the router (which requeues through the journal)
+    instead of scattering garbage into a live pool."""
+
+
+def pack_handoff(header: Dict, arrays: Dict[str, np.ndarray]) -> bytes:
+    """Pack ``arrays`` (leaf name -> page ndarray, storage dtype) plus
+    the caller's header fields into deterministic wire bytes. The
+    header must already carry the sequence/sampling fields the engine
+    needs (prompt, generated, seq_len, alloc_tokens, family, quant,
+    page_size); this function adds the wire-level leaf manifest."""
+    header = dict(header)
+    leaves = []
+    for name in sorted(arrays):
+        arr = np.ascontiguousarray(arrays[name])
+        dname = arr.dtype.name
+        if dname not in _DTYPES:
+            raise HandoffError(
+                f"leaf {name!r} has unshippable dtype {dname!r}: "
+                f"expected one of {sorted(_DTYPES)}"
+            )
+        leaves.append(
+            {"name": name, "dtype": dname, "shape": list(arr.shape)}
+        )
+    header["leaves"] = leaves
+    hj = json.dumps(
+        header, sort_keys=True, separators=(",", ":")
+    ).encode("utf-8")
+    parts = [MAGIC, struct.pack("<HI", WIRE_VERSION, len(hj)), hj]
+    for leaf in leaves:
+        parts.append(np.ascontiguousarray(arrays[leaf["name"]]).tobytes())
+    body = b"".join(parts)
+    return body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def unpack_handoff(data: bytes) -> Tuple[Dict, Dict[str, np.ndarray]]:
+    """Wire bytes -> (header, leaf arrays). Every structural check is a
+    typed :class:`HandoffError`; the returned arrays are read-only
+    views over ``data`` (zero-copy) in their recorded storage dtype —
+    bit-exact round-trip with :func:`pack_handoff`."""
+    if len(data) < 14 or data[:4] != MAGIC:
+        raise HandoffError(
+            "not a PageHandoff: bad magic (torn transfer or a "
+            "non-handoff payload on the resume channel)"
+        )
+    (crc,) = struct.unpack_from("<I", data, len(data) - 4)
+    if crc != (zlib.crc32(data[:-4]) & 0xFFFFFFFF):
+        raise HandoffError(
+            "PageHandoff checksum mismatch: the transfer was torn or "
+            "corrupted in flight — reject and let the router requeue"
+        )
+    version, hlen = struct.unpack_from("<HI", data, 4)
+    if version != WIRE_VERSION:
+        raise HandoffError(
+            f"PageHandoff wire version {version} != {WIRE_VERSION}: "
+            f"mixed-version fleet — upgrade the older replicas"
+        )
+    off = 10
+    try:
+        header = json.loads(data[off:off + hlen].decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise HandoffError(f"PageHandoff header unparseable: {e}") from None
+    off += hlen
+    arrays: Dict[str, np.ndarray] = {}
+    for leaf in header.get("leaves", []):
+        dtype = _DTYPES.get(leaf["dtype"])
+        if dtype is None:
+            raise HandoffError(
+                f"leaf {leaf['name']!r} carries dtype {leaf['dtype']!r} "
+                f"this build cannot decode"
+            )
+        shape = tuple(int(s) for s in leaf["shape"])
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        if off + nbytes > len(data) - 4:
+            raise HandoffError(
+                f"leaf {leaf['name']!r} overruns the payload "
+                f"(truncated transfer)"
+            )
+        arrays[leaf["name"]] = np.frombuffer(
+            data, dtype=dtype, count=int(np.prod(shape)), offset=off
+        ).reshape(shape)
+        off += nbytes
+    if off != len(data) - 4:
+        raise HandoffError(
+            f"{len(data) - 4 - off} trailing byte(s) after the last "
+            f"leaf: header/payload disagree"
+        )
+    return header, arrays

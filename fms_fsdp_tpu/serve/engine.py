@@ -99,6 +99,19 @@ class ServeConfig:
     # dense); "dense" replays the training-path full mixture, which is
     # the strict bit-parity mode. Other families ignore this.
     moe_impl: str = "routed"
+    # serving parallel layout: "" = single-chip (the v1 path, every
+    # parity anchor); "tp=2" / "tp=2,fsdp=2" spans one replica over a
+    # mesh — params per the family rulebook, KV pools sharded over
+    # kv-heads (parallel/sharding.py::serve_kv_pool_specs)
+    serve_layout: str = ""
+    # disaggregation role: "unified" serves end-to-end; "prefill" packs
+    # a PageHandoff after the first token instead of decoding;
+    # "decode" additionally accepts submit_handoff() resumes (it can
+    # still prefill — eviction recompute needs that)
+    role: str = "unified"
+    # a prefill engine rejects (too_large) any request whose packed
+    # handoff could exceed this many bytes; 0 = unbounded
+    handoff_max_bytes: int = 0
 
 
 class ServingEngine:
@@ -119,6 +132,15 @@ class ServingEngine:
         self.clock = clock
         self.compute_dtype = _DTYPES[scfg.compute_dtype]
 
+        from fms_fsdp_tpu.serve.disagg import ROLES
+
+        if scfg.role not in ROLES:
+            raise ValueError(
+                f"unknown serving role {scfg.role!r}: expected one of "
+                f"{ROLES} (docs/serving.md \"Sharded replicas & "
+                f"disaggregation\")"
+            )
+
         # family-specific device work (cache/slab, prefill + decode
         # jits, page accounting) — resolved from the model config, with
         # the params tree validated against it
@@ -126,6 +148,18 @@ class ServingEngine:
             params, model_cfg, scfg, self.compute_dtype
         )
         self.family = self.adapter.family
+        if scfg.role != "unified" and not self.adapter.supports_handoff:
+            raise ValueError(
+                f"role={scfg.role!r} needs page handoff, which the "
+                f"{self.family} family does not support (its decode "
+                f"state is not pure KV pages) — run {self.family} "
+                f"replicas unified"
+            )
+        if scfg.serve_layout and not self.adapter.supports_layout:
+            raise ValueError(
+                f"serve_layout={scfg.serve_layout!r} is not supported "
+                f"for the {self.family} family yet — run it single-chip"
+            )
         # back-compat surface (tests, benches, fleet introspection):
         # llama/mixtral expose their PagedKVCache here; pure-mamba has
         # no pages, so cache is None and page_size 0
@@ -154,6 +188,9 @@ class ServingEngine:
         self.last_logits = None  # (B, V) of the last decode step (debug)
         self.iterations = 0  # engine step() count (health + fault ctx)
         self._draining = False
+        # disaggregation accounting (obs schema v13 serving map)
+        self._handoff_bytes = 0  # wire bytes packed out + imported in
+        self._handoff_wall = 0.0  # seconds spent packing/scattering
 
     # -- construction ------------------------------------------------------
 
@@ -204,6 +241,34 @@ class ServingEngine:
         if err is not None:
             self._reject(REJECT_TOO_LARGE, err)
         if (
+            self.serve_cfg.role == "prefill"
+            and self.serve_cfg.handoff_max_bytes
+            and self.adapter.cache is not None
+        ):
+            # a prefill engine's output is the packed page set: bound it
+            # at the door so one pathological prompt cannot jam the
+            # handoff stream (the estimate is pure page bytes; the
+            # header adds O(prompt) ints on top)
+            cache = self.adapter.cache
+            need = cache.pages_needed(
+                self.adapter._padded_len(
+                    len(prompt), self.serve_cfg.prefill_bucket
+                )
+            )
+            page_bytes = sum(
+                int(pool.nbytes) // cache.num_pages
+                for pool in cache.pools.values()
+            )
+            est = need * page_bytes
+            if est > self.serve_cfg.handoff_max_bytes:
+                self._reject(
+                    REJECT_TOO_LARGE,
+                    f"packed handoff would carry ~{est} bytes of KV "
+                    f"pages ({need} pages), over handoff_max_bytes="
+                    f"{self.serve_cfg.handoff_max_bytes} — shrink the "
+                    f"prompt or raise the cap",
+                )
+        if (
             self.serve_cfg.max_queue
             and self.scheduler.queue_depth() >= self.serve_cfg.max_queue
         ):
@@ -239,9 +304,84 @@ class ServingEngine:
         self.registry.counter(f"serve.requests_rejected.{reason}").add()
         raise RequestRejected(reason, msg)
 
+    def submit_handoff(
+        self,
+        data: bytes,
+        max_new_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Request:
+        """Admit a request by resuming a packed PageHandoff (the wire
+        bytes a prefill-role engine produced) instead of prefilling:
+        the header restores the stream's position (prompt, generated,
+        seq_len) and its KV pages scatter bit-exact into this pool at
+        admission. ``max_new_tokens``/``deadline_s`` default to the
+        header's values (the deadline the ROUTER tracks — it re-derives
+        the remaining budget when it forwards a handoff).
+
+        Raises :class:`~fms_fsdp_tpu.serve.disagg.HandoffError` (a
+        ValueError) on malformed or geometry-mismatched bytes and
+        :class:`RequestRejected` on admission failure, same contract as
+        :meth:`submit`."""
+        from fms_fsdp_tpu.serve.disagg import unpack_handoff
+
+        if not self.adapter.supports_handoff:
+            raise ValueError(
+                f"the {self.family} family does not support page "
+                f"handoff — route its requests to unified replicas"
+            )
+        header, arrays = unpack_handoff(data)
+        self.adapter.check_handoff_header(header)
+        prompt = [int(t) for t in header["prompt"]]
+        generated = [int(t) for t in header["generated"]]
+        mnt = int(
+            header["max_new_tokens"]
+            if max_new_tokens is None
+            else max_new_tokens
+        )
+        deadline = None if deadline_s is None else self.clock() + deadline_s
+        if len(prompt) + mnt > self.serve_cfg.max_seq_len:
+            self._reject(
+                REJECT_TOO_LARGE,
+                f"handoff prompt ({len(prompt)}) + max_new_tokens "
+                f"({mnt}) exceeds max_seq_len "
+                f"({self.serve_cfg.max_seq_len})",
+            )
+        err = self.adapter.admission_error(len(prompt), mnt)
+        if err is not None:
+            self._reject(REJECT_TOO_LARGE, err)
+        if (
+            self.serve_cfg.max_queue
+            and self.scheduler.queue_depth() >= self.serve_cfg.max_queue
+        ):
+            self._reject(
+                REJECT_OVERLOADED,
+                f"queue holds {self.scheduler.queue_depth()} requests "
+                f"(max_queue={self.serve_cfg.max_queue}): shedding at "
+                f"admission — back off and retry",
+            )
+        if self._draining:
+            self._reject(
+                REJECT_OVERLOADED,
+                "engine is draining: not admitting new requests",
+            )
+        req = Request(prompt, mnt, deadline)
+        req.generated = generated
+        req.handoff_in = (header, arrays, len(data))
+        self.scheduler.submit(req)
+        # the first token was already served (by the prefill engine):
+        # this stream must never expire as "unserved queued work", and
+        # its TTFT was recorded where it was paid
+        req.first_token_time = req.submit_time
+        self.registry.counter("serve.requests_submitted").add()
+        self.registry.counter("serve.handoffs_accepted").add()
+        return req
+
     # -- prefill -----------------------------------------------------------
 
     def _prefill_request(self, req: Request, slot: int) -> None:
+        if req.handoff_in is not None:
+            self._import_handoff(req, slot)
+            return
         prompt = req.resume_prompt()
         p = len(prompt)
         # the adapter allocates the stream's decode state (pages and/or
@@ -272,6 +412,59 @@ class ServingEngine:
         self._lens[slot] = p
         if self._finish_if_done(req, slot, now=now):
             return
+        if self.serve_cfg.role == "prefill":
+            # disaggregation: a prefill engine's job ends at the first
+            # token — pack the stream's pages + sampling state into wire
+            # bytes and retire the request; the replica loop emits it as
+            # a "handoff" message instead of "done"
+            self._export_handoff(req, slot)
+
+    def _import_handoff(self, req: Request, slot: int) -> None:
+        """The decode half of a handoff admission: scatter the shipped
+        pages into this pool and restore the stream's decode position —
+        no prefill compute at all, which is the disaggregation win (a
+        long-prompt prefill never stalls this engine's decode step)."""
+        header, arrays, nbytes = req.handoff_in
+        t0 = self.clock()
+        ok = self.adapter.import_handoff(req.rid, slot, header, arrays)
+        assert ok, "admission checked capacity; scatter cannot fail here"
+        self._handoff_wall += self.clock() - t0
+        self._handoff_bytes += nbytes
+        self.registry.counter("serve.handoffs_imported").add()
+        self.registry.counter("serve.handoff_bytes").add(nbytes)
+        req.handoff_in = None  # eviction after this point recomputes
+        self._slots[slot] = req
+        self._admit_order.append(req)
+        self._tokens[slot] = req.generated[-1]
+        self._lens[slot] = int(header["seq_len"])
+        if self._finish_if_done(req, slot):
+            return
+
+    def _export_handoff(self, req: Request, slot: int) -> None:
+        """The prefill half: gather the stream's pages, pack them with
+        the sampling state (prompt, generated, position) into
+        deterministic wire bytes, then retire the stream — its pages
+        free only AFTER the gather read them."""
+        from fms_fsdp_tpu.serve.disagg import pack_handoff
+
+        t0 = self.clock()
+        header, arrays = self.adapter.export_handoff(req.rid)
+        header.update(
+            prompt=[int(t) for t in req.prompt],
+            generated=[int(t) for t in req.generated],
+            seq_len=int(self._lens[slot]),
+            max_new_tokens=int(req.max_new_tokens),
+        )
+        req.handoff_out = pack_handoff(header, arrays)
+        self._handoff_wall += self.clock() - t0
+        self._handoff_bytes += len(req.handoff_out)
+        self.registry.counter("serve.handoffs_exported").add()
+        self.registry.counter("serve.handoff_bytes").add(
+            len(req.handoff_out)
+        )
+        self.scheduler.mark_finished(req)
+        self._release_slot(req, slot)
+        self._finished_buf.append(req)
 
     # -- lifecycle helpers -------------------------------------------------
 
@@ -324,6 +517,13 @@ class ServingEngine:
             self.registry.counter("serve.requests_expired_inflight").add()
 
         def can_fit(req: Request) -> bool:
+            if req.handoff_in is not None:
+                # a handoff admission allocates the shipped page set,
+                # not a padded prefill; seq_len is the position the
+                # pages cover
+                return self.adapter.can_admit(
+                    req.rid, int(req.handoff_in[0]["seq_len"])
+                )
             return self.adapter.can_admit(
                 req.rid, len(req.resume_prompt())
             )
@@ -471,4 +671,25 @@ class ServingEngine:
             "state_bytes_per_stream": float(
                 self.adapter.state_bytes_per_stream
             ),
+            # v13: disaggregation + serving layout — numeric role code
+            # (serve/disagg/ROLE_CODES), the layout as 100*tp + fsdp
+            # (0 = single-chip), and cumulative handoff wire traffic
+            "role": float(_role_code(self.serve_cfg.role)),
+            "serve_layout": float(
+                _layout_code(self.serve_cfg.serve_layout)
+            ),
+            "handoff_bytes": float(self._handoff_bytes),
+            "handoff_s": float(self._handoff_wall),
         }
+
+
+def _role_code(role: str) -> int:
+    from fms_fsdp_tpu.serve.disagg import ROLE_CODES
+
+    return ROLE_CODES[role]
+
+
+def _layout_code(layout: str) -> int:
+    from fms_fsdp_tpu.parallel.sharding import serve_layout_code
+
+    return serve_layout_code(layout)

@@ -203,6 +203,15 @@ class FamilyAdapter:
       (B,) np.int32, logits (B, V)). The adapter owns donation and
       page-table upload caching.
     - ``pages_in_use`` / ``state_bytes_per_stream`` — obs.
+
+    Disaggregation (serve/disagg/): paged families additionally set
+    ``supports_handoff`` and inherit the base ``export_handoff`` /
+    ``import_handoff`` (the whole transferable state IS the page set,
+    so the generic pool gather/scatter covers llama and mixtral
+    identically); families with non-page decode state (the mamba slab)
+    leave it False and the engine rejects prefill/decode roles at
+    build with the fix spelled out. ``supports_layout`` gates
+    ``ServeConfig.serve_layout`` the same way.
     """
 
     family: str = "?"
@@ -212,6 +221,9 @@ class FamilyAdapter:
     attn_impl: str = "none"
     block_kv: int = 0
     tune_how: str = "n/a"
+    mesh = None  # the serving mesh when serve_layout is set, else None
+    supports_handoff: bool = False
+    supports_layout: bool = False
 
     def admission_error(self, prompt_len: int, max_new: int) -> Optional[str]:
         raise NotImplementedError
@@ -230,6 +242,138 @@ class FamilyAdapter:
 
     def decode(self, slot_rids, lens, tokens, key):
         raise NotImplementedError
+
+    # -- serving layout (ServeConfig.serve_layout) -------------------------
+
+    def _init_layout(self, scfg) -> None:
+        """Resolve ``scfg.serve_layout`` into the replica's serving mesh
+        and place ``self.params`` through the family's spec rulebook
+        (parallel/sharding.py::serve_param_specs — tp over heads/ffn,
+        fsdp ZeRO-style, exactly the train-side placements). The empty
+        layout is a strict no-op: single-chip engines never touch a
+        mesh, so every existing parity anchor runs byte-identical code.
+        Adapters that support layouts call this before building pools;
+        the engine rejects ``serve_layout`` for families that don't."""
+        self.mesh = None
+        self._repl = None
+        if not scfg.serve_layout or not self.supports_layout:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from fms_fsdp_tpu.parallel.sharding import (
+            build_serve_mesh,
+            serve_param_specs,
+            shard_params,
+        )
+
+        self.mesh = build_serve_mesh(scfg.serve_layout)
+        if self.mesh is None:  # "tp=1" etc: explicit single-chip
+            return
+        self.params = shard_params(
+            self.params, serve_param_specs(self.family), self.mesh
+        )
+        self._repl = NamedSharding(self.mesh, PartitionSpec())
+
+    def _pool_shardings(self, value_shape):
+        """NamedShardings for pool leaves of ``value_shape`` =
+        (L, num_pages, page_size, Nkv, H): kv-heads over the tensor
+        axis (serve_kv_pool_specs). None single-chip — the pool then
+        builds exactly as before."""
+        if getattr(self, "mesh", None) is None:
+            return None
+        from fms_fsdp_tpu.parallel.sharding import (
+            named_sharding,
+            serve_kv_pool_specs,
+        )
+
+        specs = serve_kv_pool_specs(self.scfg.kv_quant)
+        return {
+            name: named_sharding(
+                self.mesh,
+                spec,
+                value_shape[:-1] + (1,)
+                if name.endswith("_scale")
+                else value_shape,
+            )
+            for name, spec in specs.items()
+        }
+
+    def _dev(self, x):
+        """Host array -> device, replicated over the serving mesh when
+        one exists (page tables, seq lens, tokens, rng keys — the small
+        per-step inputs every mesh device reads whole). Single-chip:
+        plain jnp.asarray, the historical path."""
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        if getattr(self, "_repl", None) is not None:
+            x = jax.device_put(x, self._repl)
+        return x
+
+    # -- disaggregation (generic paged implementation) ---------------------
+
+    def export_handoff(self, rid: int):
+        """Read rid's transferable decode state: returns (header
+        fields, leaf arrays) for serve/disagg/handoff.py::pack_handoff.
+        The generic implementation ships the sequence's KV pages in
+        storage dtype; the engine adds the sampling fields (prompt,
+        generated) before packing."""
+        assert self.supports_handoff and self.cache is not None, (
+            f"{self.family} does not support page handoff"
+        )
+        cache = self.cache
+        return (
+            {
+                "family": self.family,
+                "quant": cache.quant,
+                "page_size": cache.page_size,
+                "n_kv_heads": cache.n_kv_heads,
+                "head_dim": cache.head_dim,
+                "n_layers": cache.n_layers,
+                "alloc_tokens": cache.tokens_of(rid),
+            },
+            cache.gather_pages(rid),
+        )
+
+    def check_handoff_header(self, header) -> None:
+        """Raise HandoffError when a handoff's pool geometry does not
+        match this replica's — a fleet whose prefill and decode replicas
+        disagree on model config / ServeConfig is misconfigured, not out
+        of capacity, so this is a typed error, not a deferral. Called at
+        submit (fail the resume at the door) and again by
+        ``import_handoff`` (belt and braces for direct callers)."""
+        from fms_fsdp_tpu.serve.disagg import HandoffError
+
+        assert self.supports_handoff and self.cache is not None, (
+            f"{self.family} does not support page handoff"
+        )
+        cache = self.cache
+        for field, mine in (
+            ("family", self.family),
+            ("quant", cache.quant),
+            ("page_size", cache.page_size),
+            ("n_kv_heads", cache.n_kv_heads),
+            ("head_dim", cache.head_dim),
+            ("n_layers", cache.n_layers),
+        ):
+            if header.get(field) != mine:
+                raise HandoffError(
+                    f"handoff {field}={header.get(field)!r} does not "
+                    f"match this replica's {field}={mine!r}: prefill "
+                    f"and decode replicas must share one model config "
+                    f"and ServeConfig pool geometry"
+                )
+
+    def import_handoff(self, rid: int, slot: int, header, arrays) -> bool:
+        """The receiving half: allocate rid's pages in this pool and
+        scatter the shipped leaves in, bit-exact. Returns False when the
+        pool cannot hold them right now (the engine defers/evicts, same
+        contract as ``grow``)."""
+        self.check_handoff_header(header)
+        return self.cache.scatter_pages(
+            rid, arrays, int(header["alloc_tokens"])
+        )
 
     @property
     def pages_in_use(self) -> int:

@@ -23,6 +23,8 @@ from fms_fsdp_tpu.serve.kv_cache import RESERVED_PAGES, PagedKVCache
 
 class LlamaAdapter(FamilyAdapter):
     family = "llama"
+    supports_handoff = True
+    supports_layout = True
 
     def __init__(self, params, model_cfg, scfg, compute_dtype=None):
         from fms_fsdp_tpu.serve.engine import _DTYPES
@@ -32,6 +34,11 @@ class LlamaAdapter(FamilyAdapter):
         self.model_cfg = model_cfg
         self.scfg = scfg
         self.compute_dtype = compute_dtype or _DTYPES[scfg.compute_dtype]
+        # serve_layout: build the serving mesh + shard params (tp over
+        # heads/ffn, fsdp ZeRO-style — the train rulebook). No-op when
+        # unset, keeping the single-chip bit-parity anchor byte-exact.
+        self._init_layout(scfg)
+        params = self.params
 
         nlayers = int(params["layers"]["wq"].shape[0])
         page_size, self.block_kv, self.tune_how = resolve_paged_decode(
@@ -59,6 +66,16 @@ class LlamaAdapter(FamilyAdapter):
             model_cfg.head_dim,
             dtype=self.compute_dtype,
             quant=scfg.kv_quant,
+            # kv-head-sharded pools on a serving mesh; None single-chip
+            shardings=self._pool_shardings(
+                (
+                    nlayers,
+                    num_pages,
+                    page_size,
+                    model_cfg.n_kv_heads,
+                    model_cfg.head_dim,
+                )
+            ),
         )
         impl = scfg.attn_impl
         if impl == "auto":
@@ -149,11 +166,15 @@ class LlamaAdapter(FamilyAdapter):
         toks[0, :p] = prompt
         full_logits = p_pad != p
         logits, _, kv = self._get_prefill(p_pad, s_pad, full_logits)(
-            self.params, jnp.asarray(toks)
+            self.params, self._dev(toks)
         )
         self.cache.write_prompt(rid, kv["k"][:, 0], kv["v"][:, 0])
         # logits of the last REAL position predict the next token
-        return logits[0, p - 1] if full_logits else logits[0, 0]
+        row = logits[0, p - 1] if full_logits else logits[0, 0]
+        # on a mesh, hand the engine a host row: the engine's eager
+        # sampler mixes it with its single-device rng key, which jax
+        # refuses across device sets
+        return np.asarray(row) if self.mesh is not None else row
 
     # -- decode ------------------------------------------------------------
 
@@ -163,16 +184,16 @@ class LlamaAdapter(FamilyAdapter):
         tkey = (self.cache.table_version, tuple(slot_rids))
         if tkey != self._table_key:
             self._table_key = tkey
-            self._table_dev = jnp.asarray(
+            self._table_dev = self._dev(
                 self.cache.page_table(list(slot_rids), self.max_pages)
             )
         toks, logits, pools = self._decode_fn(
             self.params,
             self.cache.pools,
             self._table_dev,
-            jnp.asarray(lens),
-            jnp.asarray(tokens),
-            key,
+            self._dev(lens),
+            self._dev(tokens),
+            self._dev(key),
         )
         self.cache.pools = pools
         return np.asarray(toks), logits

@@ -51,6 +51,14 @@ class MambaAdapter(FamilyAdapter):
         cfg = model_cfg
         self._hybrid = bool(cfg.attn_layer_idx)
 
+        if scfg.serve_layout:
+            raise ValueError(
+                "mamba serving has no sharded layout yet: the recurrent "
+                "slab (conv window + SSD state) has no sharding rulebook"
+                " — run mamba replicas single-chip (serve_layout=\"\") "
+                "and scale them out data-parallel through the fleet "
+                "router"
+            )
         if scfg.attn_impl == "kernel":
             raise ValueError(
                 "mamba serving has no paged-attention kernel path yet: "

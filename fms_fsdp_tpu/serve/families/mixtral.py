@@ -33,6 +33,8 @@ from fms_fsdp_tpu.serve.kv_cache import RESERVED_PAGES, PagedKVCache
 
 class MixtralAdapter(FamilyAdapter):
     family = "mixtral"
+    supports_handoff = True
+    supports_layout = True
 
     def __init__(self, params, model_cfg, scfg, compute_dtype=None):
         from fms_fsdp_tpu.serve.engine import _DTYPES
@@ -63,6 +65,12 @@ class MixtralAdapter(FamilyAdapter):
                 "set kv_quant='none'"
             )
         self.attn_impl = "reference"
+        # serve_layout: mesh + sharded params (attention follows the
+        # llama megatron layout; expert weights keep their fsdp/tensor
+        # in-expert sharding — the expert axis is absent from the
+        # serving mesh, so resolve_spec replicates the E dim)
+        self._init_layout(scfg)
+        params = self.params
 
         nlayers = int(params["layers"]["wq"].shape[0])
         page_size, self.block_kv, self.tune_how = resolve_paged_decode(
@@ -90,6 +98,10 @@ class MixtralAdapter(FamilyAdapter):
             cfg.head_dim,
             dtype=self.compute_dtype,
             quant="none",
+            shardings=self._pool_shardings(
+                (nlayers, num_pages, page_size, cfg.n_kv_heads,
+                 cfg.head_dim)
+            ),
         )
         self._prefill_cache: Dict = {}
         self._table_key = None
@@ -168,10 +180,11 @@ class MixtralAdapter(FamilyAdapter):
         toks[0, :p] = prompt
         full_logits = p_pad != p
         logits, _, kv = self._get_prefill(p_pad, s_pad, full_logits)(
-            self.params, jnp.asarray(toks)
+            self.params, self._dev(toks)
         )
         self.cache.write_prompt(rid, kv["k"][:, 0], kv["v"][:, 0])
-        return logits[0, p - 1] if full_logits else logits[0, 0]
+        row = logits[0, p - 1] if full_logits else logits[0, 0]
+        return np.asarray(row) if self.mesh is not None else row
 
     # -- decode ------------------------------------------------------------
 
@@ -179,16 +192,16 @@ class MixtralAdapter(FamilyAdapter):
         tkey = (self.cache.table_version, tuple(slot_rids))
         if tkey != self._table_key:
             self._table_key = tkey
-            self._table_dev = jnp.asarray(
+            self._table_dev = self._dev(
                 self.cache.page_table(list(slot_rids), self.max_pages)
             )
         toks, logits, pools = self._decode_fn(
             self.params,
             self.cache.pools,
             self._table_dev,
-            jnp.asarray(lens),
-            jnp.asarray(tokens),
-            key,
+            self._dev(lens),
+            self._dev(tokens),
+            self._dev(key),
         )
         self.cache.pools = pools
         return np.asarray(toks), logits

@@ -11,12 +11,29 @@ stdin/stdout to one :class:`FleetRouter` in the parent:
 
     router -> replica:  {"type": "submit", "rid", "prompt",
                          "max_new_tokens", "deadline_s"}
+                        {"type": "resume", "rid", "data",
+                         "max_new_tokens", "deadline_s"}   (disagg: a
+                        base64 PageHandoff for a decode-role replica)
                         {"type": "drain"}
     replica -> router:  {"type": "hb", "iterations", "completed",
                          "slots_busy", "queue_depth"}        (heartbeat,
                         every engine iteration and on idle ticks)
                         {"type": "done", "rid", "tokens"}
+                        {"type": "handoff", "rid", "data", "bytes",
+                         "ttft"}                 (prefill-role replicas)
                         {"type": "reject", "rid", "reason"}
+
+Disaggregation (``FleetConfig.prefill_replicas`` > 0): the first K
+replica indices run ``role="prefill"`` engines, the rest
+``role="decode"``. A fresh request dispatches to a prefill replica,
+which answers with a ``handoff`` — the stream's KV pages + sampling
+state packed into deterministic wire bytes (serve/disagg/handoff.py).
+The router JOURNALS the handoff before forwarding it as a ``resume`` to
+a decode replica, so the transfer itself is crash-safe on both sides:
+a prefill replica that dies mid-handoff never journaled one and its rid
+requeues to re-prefill; a decode replica that dies after accepting one
+requeues WITH the journaled bytes and the resume replays on a sibling —
+exactly-once either way, through the same dedup gate as ``done``.
 
 Durability lives at the ROUTER, not the replicas: a request is journaled
 at admission (:class:`RequestJournal`) and every state transition —
@@ -123,8 +140,18 @@ class JournalRecord:
     fail_reason: str = ""
     # engine-reported time-to-first-token of the COMPLETING
     # incarnation (a duration; requeue waits are visible in ``latency``
-    # instead, which spans admission to delivery on the router clock)
+    # instead, which spans admission to delivery on the router clock).
+    # In a disagg fleet the prefill side's handoff carries the true
+    # TTFT — the decode side never re-records it.
     engine_ttft: Optional[float] = None
+    # disaggregation: the journaled PageHandoff (base64 wire bytes)
+    # once a prefill replica produced it; a rid carrying one dispatches
+    # as a "resume" to a decode replica, and a decode-side death
+    # requeues the BYTES, not a recompute
+    handoff: Optional[str] = None
+    handoff_bytes: int = 0
+    handoff_t: Optional[float] = None
+    handoffs: int = 0  # times a prefill replica handed this rid off
 
     @property
     def latency(self) -> Optional[float]:
@@ -234,7 +261,36 @@ class RequestJournal:
         rec.state = J_COMPLETED
         rec.tokens = list(tokens)
         rec.finish_t = self.clock()
+        rec.handoff = None  # delivered: the journaled bytes are dead
         self._event("complete", rid, n_tokens=len(rec.tokens))
+        return True
+
+    def handoff(self, rid: int, data: str, nbytes: int) -> bool:
+        """A prefill replica handed this rid off: journal the wire
+        bytes and move the rid back to QUEUED so dispatch forwards it
+        to a decode replica. The journal write IS the crash-safety
+        point — from here on, a death on either side replays these
+        bytes instead of recomputing the prefill. Returns False (and
+        counts a duplicate) when the rid is already terminal — a
+        handoff that raced a completion or expiry must not resurrect
+        the request."""
+        rec = self.records.get(rid)
+        if rec is None or rec.state in (J_COMPLETED, J_EXPIRED, J_FAILED):
+            self.duplicates_dropped += 1
+            self._event("duplicate_dropped", rid, kind="handoff")
+            return False
+        if rec.state == J_ASSIGNED:
+            self._inflight.get(rec.run_id, set()).discard(rid)
+        rec.state = J_QUEUED
+        rec.replica = None
+        rec.run_id = ""
+        rec.handoff = data
+        rec.handoff_bytes = int(nbytes)
+        rec.handoff_t = self.clock()
+        rec.handoffs += 1
+        if rid not in self.queued:
+            self.queued.appendleft(rid)
+        self._event("handoff", rid, bytes=int(nbytes))
         return True
 
     def requeue_incarnation(self, run_id: str) -> List[int]:
@@ -251,6 +307,10 @@ class RequestJournal:
             rec.replica = None
             rec.run_id = ""
             rec.requeues += 1
+            # rec.handoff survives on purpose: a rid that died on a
+            # DECODE replica re-dispatches its journaled bytes; one
+            # that died on the PREFILL side never had any and
+            # re-prefills from the prompt
             self.queued.appendleft(rid)
             self.requeued_total += 1
             self._event("requeue", rid, from_run_id=run_id)
@@ -423,12 +483,18 @@ def make_subprocess_spawn(
     faults: str = "",
     env_extra: Optional[Dict[str, str]] = None,
     python: Optional[str] = None,
+    prefill_replicas: int = 0,
 ):
     """Build the supervisor spawn callback for real
     ``serve/replica.py`` children. Writes the model/serve config JSONs
     under ``workdir`` once; each spawn launches
     ``python -m fms_fsdp_tpu.serve.replica`` with stderr teed to a
     per-incarnation log (``workdir/replica<K>-i<N>.stderr``).
+
+    ``prefill_replicas`` mirrors FleetConfig: when > 0, replica indices
+    below it get a ``role="prefill"`` ServeConfig and the rest
+    ``role="decode"`` (two config JSONs, the role the only difference —
+    disagreeing pool geometry is a typed HandoffError at resume).
 
     ``faults`` (an FMS_FAULTS spec) is exported ONLY to incarnation 0
     of each replica: fault fire-counters are per process, so a
@@ -444,7 +510,14 @@ def make_subprocess_spawn(
     with open(mpath, "w") as f:
         json.dump(model_cfg, f)
     with open(spath, "w") as f:
-        json.dump(serve_cfg, f)
+        if prefill_replicas > 0:
+            json.dump(dict(serve_cfg, role="decode"), f)
+        else:
+            json.dump(serve_cfg, f)
+    ppath = os.path.join(workdir, "serve_cfg_prefill.json")
+    if prefill_replicas > 0:
+        with open(ppath, "w") as f:
+            json.dump(dict(serve_cfg, role="prefill"), f)
     py = python or _sys.executable
 
     def spawn(ctx: dict) -> "SubprocessReplica":
@@ -455,10 +528,13 @@ def make_subprocess_spawn(
         else:
             env.pop("FMS_FAULTS", None)
         env["FMS_RUN_ID"] = ctx["run_id"]
+        scfg_path = (
+            ppath if ctx["replica"] < prefill_replicas else spath
+        )
         argv = [
             py, "-m", "fms_fsdp_tpu.serve.replica",
             "--model-cfg", mpath,
-            "--serve-cfg", spath,
+            "--serve-cfg", scfg_path,
             "--replica", str(ctx["replica"]),
         ]
         if params:
@@ -500,6 +576,11 @@ class FleetConfig:
     max_restarts_per_replica: int = 8
     crash_loop_threshold: int = 3
     drain_grace_s: float = 10.0
+    # disaggregation: the first K replica indices are prefill-role, the
+    # remaining n_replicas - K decode-role; 0 = every replica unified
+    # (the v1 fleet). Fresh rids dispatch only to prefill replicas,
+    # handoff-carrying rids only to decode replicas.
+    prefill_replicas: int = 0
 
 
 class FleetRouter:
@@ -547,7 +628,14 @@ class FleetRouter:
         }
         self.expired = 0
         self.failed = 0
+        self.handoffs = 0  # handoff messages journaled (incl. repeats)
         self._started = False
+        if not 0 <= cfg.prefill_replicas < max(1, cfg.n_replicas):
+            raise ValueError(
+                f"prefill_replicas={cfg.prefill_replicas} must leave at "
+                f"least one decode replica out of n_replicas="
+                f"{cfg.n_replicas} (0 disables disaggregation)"
+            )
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -739,9 +827,19 @@ class FleetRouter:
             elif t == "done":
                 if self.journal.complete(msg["rid"], msg["tokens"]):
                     rec = self.journal.records[msg["rid"]]
-                    rec.engine_ttft = msg.get("ttft")
+                    if rec.engine_ttft is None:
+                        # disagg: the prefill side's handoff already
+                        # carried the true TTFT — keep it
+                        rec.engine_ttft = msg.get("ttft")
                     self.completed.append(rec)
                     delivered.append(rec)
+            elif t == "handoff":
+                if self.journal.handoff(
+                    msg["rid"], msg["data"], msg.get("bytes", 0)
+                ):
+                    self.handoffs += 1
+                    rec = self.journal.records[msg["rid"]]
+                    rec.engine_ttft = msg.get("ttft")
             elif t == "expired":
                 if self.journal.expire_assigned(msg["rid"]):
                     self.expired += 1
@@ -756,6 +854,17 @@ class FleetRouter:
                 self.failed += 1
         return delivered
 
+    def _eligible(self, rec: JournalRecord, live: List[int]) -> List[int]:
+        """The replica indices allowed to take this record. Unified
+        fleets: everyone. Disagg fleets: fresh rids go to the prefill
+        indices, handoff-carrying rids to the decode indices."""
+        k = self.cfg.prefill_replicas
+        if k <= 0:
+            return live
+        if rec.handoff is None:
+            return [i for i in live if i < k]
+        return [i for i in live if i >= k]
+
     def _dispatch(self) -> None:
         # only READY replicas take work: a cold replica (importing,
         # compiling) would sit on assignments the others could serve
@@ -766,15 +875,22 @@ class FleetRouter:
         if not live:
             return
         while self.journal.queued:
+            rid = self.journal.queued[0]
+            rec = self.journal.records[rid]
+            # head-of-line, no bypass (same contract as the engine's
+            # FIFO admission): if the head's role pool is down or
+            # saturated, the queue waits — the supervisor is relaunching
+            # the pool, and bypassing would reorder delivery
+            eligible = self._eligible(rec, live)
+            if not eligible:
+                return
             loads = [
                 (self.journal.inflight(self.supervisor.run_id(i)), i)
-                for i in live
+                for i in eligible
             ]
             load, idx = min(loads)
             if load >= self.cfg.max_inflight_per_replica:
-                return  # every replica is saturated; keep queued
-            rid = self.journal.queued[0]
-            rec = self.journal.records[rid]
+                return  # every eligible replica is saturated
             handle = self.supervisor.handle(idx)
             run_id = self.supervisor.run_id(idx)
             # journal deadlines are absolute router-clock; the engine
@@ -784,15 +900,23 @@ class FleetRouter:
                 if rec.deadline_s is None
                 else max(0.0, rec.deadline_s - self.clock())
             )
-            ok = handle is not None and handle.send(
-                {
+            if rec.handoff is not None:
+                msg = {
+                    "type": "resume",
+                    "rid": rid,
+                    "data": rec.handoff,
+                    "max_new_tokens": rec.max_new_tokens,
+                    "deadline_s": remaining,
+                }
+            else:
+                msg = {
                     "type": "submit",
                     "rid": rid,
                     "prompt": rec.prompt,
                     "max_new_tokens": rec.max_new_tokens,
                     "deadline_s": remaining,
                 }
-            )
+            ok = handle is not None and handle.send(msg)
             if not ok:
                 # pipe already gone: the supervisor sweep will reap it
                 # next tick; stop dispatching to it
@@ -842,5 +966,13 @@ class FleetRouter:
             "p99_latency_s": float(p99),
             "completion_rate": (
                 float(c[J_COMPLETED]) / admitted if admitted else 1.0
+            ),
+            # disaggregation (0s in a unified fleet)
+            "prefill_replicas": float(self.cfg.prefill_replicas),
+            "requests_handed_off": float(self.handoffs),
+            "handoff_bytes": float(
+                sum(
+                    r.handoff_bytes for r in self.journal.records.values()
+                )
             ),
         }

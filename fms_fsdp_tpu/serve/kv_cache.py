@@ -65,6 +65,7 @@ class PagedKVCache:
         head_dim: int,
         dtype=jnp.bfloat16,
         quant: str = "none",
+        shardings: Optional[Dict] = None,
     ):
         assert num_pages > RESERVED_PAGES, (
             f"num_pages={num_pages}: pages 0/1 are reserved (zero/scratch), "
@@ -90,6 +91,20 @@ class PagedKVCache:
             sshape = (n_layers, num_pages, page_size, n_kv_heads, 1)
             self.pools["k_scale"] = jnp.zeros(sshape, jnp.float32)
             self.pools["v_scale"] = jnp.zeros(sshape, jnp.float32)
+        if shardings:
+            # serving-layout placement (leaf name -> jax Sharding):
+            # pools born sharded stay sharded — every later .at[].set /
+            # gather propagates the operand's sharding under GSPMD
+            import jax
+
+            self.pools = {
+                name: (
+                    jax.device_put(pool, shardings[name])
+                    if name in shardings
+                    else pool
+                )
+                for name, pool in self.pools.items()
+            }
 
         self._free: List[int] = list(range(RESERVED_PAGES, num_pages))
         heapq.heapify(self._free)
@@ -231,6 +246,50 @@ class PagedKVCache:
                 "k_scale": self.pools["k_scale"].at[:, ids].set(sk),
                 "v_scale": self.pools["v_scale"].at[:, ids].set(sv),
             }
+
+    # -- page export / import (serve/disagg/ handoff) ----------------------
+
+    def gather_pages(self, seq_id: int) -> Dict[str, "object"]:
+        """Read seq_id's pages out of the device pools as host arrays:
+        leaf name -> (L, n_pages, page_size, Nkv, H|1) ndarray in the
+        pool's STORAGE dtype — int8/fp8 pages come out as their 1-byte
+        values plus the fp32 scale leaves, never dequantized (the
+        handoff ships what the pool holds, bit for bit)."""
+        import numpy as np
+
+        pages = self._seq_pages.get(seq_id, [])
+        assert pages, f"sequence {seq_id} holds no pages to gather"
+        ids = jnp.asarray(pages, jnp.int32)
+        return {
+            name: np.asarray(pool[:, ids])
+            for name, pool in self.pools.items()
+        }
+
+    def scatter_pages(self, seq_id: int, arrays: Dict, n_tokens: int) -> bool:
+        """The unpack half: allocate exactly the shipped page count for
+        ``seq_id`` (all-or-nothing, like ``ensure``) and write each leaf
+        into the freshly allocated page ids. Reserved pages are never
+        written — page 0 stays all-zero (the bit-parity root) and page 1
+        stays scratch. ``n_tokens`` is the source pool's token
+        accounting for the sequence (its ``tokens_of``)."""
+        n = int(arrays["k"].shape[1])
+        assert set(arrays) == set(self.pools), (
+            f"handoff leaves {sorted(arrays)} do not match this pool's "
+            f"{sorted(self.pools)} — kv_quant mismatch between replicas"
+        )
+        if not self.ensure(seq_id, n * self.page_size):
+            return False
+        self._seq_tokens[seq_id] = n_tokens
+        pages = self._seq_pages[seq_id]
+        assert len(pages) == n, (len(pages), n)
+        ids = jnp.asarray(pages, jnp.int32)
+        self.pools = {
+            name: pool.at[:, ids].set(
+                jnp.asarray(arrays[name], pool.dtype)
+            )
+            for name, pool in self.pools.items()
+        }
+        return True
 
     # -- defrag ------------------------------------------------------------
 

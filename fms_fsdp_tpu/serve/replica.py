@@ -3,7 +3,11 @@
 Launched by the :class:`~fms_fsdp_tpu.serve.fleet.FleetRouter` (via the
 ReplicaSetSupervisor's spawn callback), this process speaks the
 line-delimited JSON protocol on stdin/stdout documented in
-serve/fleet.py: ``submit``/``drain`` in, ``hb``/``done``/``reject`` out.
+serve/fleet.py: ``submit``/``resume``/``drain`` in,
+``hb``/``done``/``handoff``/``reject`` out. Disaggregated fleets route
+fresh requests to prefill-role replicas (whose engines retire each
+stream as a packed PageHandoff, emitted here as a base64 ``handoff``
+message) and ``resume`` the wire bytes on a decode-role replica.
 stdout is the protocol channel — nothing else may print there (jax and
 tracebacks go to stderr, which the router redirects to a per-incarnation
 log file).
@@ -37,6 +41,7 @@ token-parity assertion keys on).
 """
 
 import argparse
+import base64
 import json
 import os
 import sys
@@ -154,6 +159,32 @@ def serve_loop(engine, replica_idx: int, idle_sleep_s: float = 0.02):
                             "reason": e.reason,
                         }
                     )
+            elif msg.get("type") == "resume":
+                # disaggregation: admit by importing a packed handoff
+                # (KV pages + sampling state) instead of prefilling
+                try:
+                    req = engine.submit_handoff(
+                        base64.b64decode(msg["data"]),
+                        max_new_tokens=msg.get("max_new_tokens"),
+                        deadline_s=msg.get("deadline_s"),
+                    )
+                    by_req[id(req)] = (req, msg["rid"])
+                except RequestRejected as e:
+                    _emit(
+                        {
+                            "type": "reject",
+                            "rid": msg["rid"],
+                            "reason": e.reason,
+                        }
+                    )
+                except ValueError as e:  # HandoffError: bad wire bytes
+                    _emit(
+                        {
+                            "type": "reject",
+                            "rid": msg["rid"],
+                            "reason": f"handoff_error: {e}",
+                        }
+                    )
             elif msg.get("type") == "drain":
                 draining = True
                 engine.drain()
@@ -191,6 +222,23 @@ def serve_loop(engine, replica_idx: int, idle_sleep_s: float = 0.02):
             for req in engine.step():
                 ent = by_req.pop(id(req), None)
                 if ent is None:
+                    continue
+                if req.handoff_out is not None:
+                    # prefill role: the stream's pages + state, packed.
+                    # The router journals these bytes BEFORE forwarding
+                    # to a decode replica — a death on either side of a
+                    # half-shipped handoff replays from the journal.
+                    _emit(
+                        {
+                            "type": "handoff",
+                            "rid": ent[1],
+                            "data": base64.b64encode(
+                                req.handoff_out
+                            ).decode("ascii"),
+                            "bytes": len(req.handoff_out),
+                            "ttft": req.ttft,
+                        }
+                    )
                     continue
                 _emit(
                     {

@@ -93,6 +93,14 @@ class Request:
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
     evictions: int = 0
+    # disaggregation (serve/disagg/): a decode-role engine admits this
+    # request by importing packed KV pages instead of prefilling —
+    # ``handoff_in`` holds (header, arrays, nbytes) from unpack_handoff
+    # until consumed at admission (eviction afterwards falls back to
+    # recompute-on-resume); a prefill-role engine finishes a request by
+    # packing its pages into ``handoff_out`` wire bytes
+    handoff_in: Optional[tuple] = None
+    handoff_out: Optional[bytes] = None
 
     @property
     def ttft(self) -> Optional[float]:

@@ -21,6 +21,21 @@ hard-killed mid-stream — serve/fleet.py) share one schema; the churn
 row also carries ``replica_availability`` (the restart-ledger capacity
 metric, < 1.0 under churn) and the relaunch/requeue counts.
 
+PR 18 rows (every row now carries ``serve_layout``, "" = single-chip):
+
+- ``sharded``: the llama steady-state wave on a ``serve_layout=tp=2``
+  replica (parallel/sharding.py serving mesh; on a TPU-less host the
+  mesh comes from 8 forced host-platform CPU devices, so the number is
+  a CPU-relative but measured sharded-step cost);
+- ``fleet-unified`` / ``fleet-disagg``: the SAME mixed wave — short
+  prompts with long-prompt interferers — on a 3-replica unified fleet
+  vs a disaggregated one (1 prefill + 2 decode,
+  ``FleetConfig.prefill_replicas``; serve/disagg/). Their ``ttft_s``
+  is computed over the SHORT requests only: the pair quantifies what
+  moving interferer prefill off the decode path buys p99 TTFT. The
+  disagg row carries the handoff ledger (``requests_handed_off``,
+  ``handoff_bytes``, ``prefill_replicas``).
+
 Fallback-tier contract (bench.py's): the engine measures on whatever
 backend answers — on a TPU-less host the numbers are CPU-relative but
 MEASURED, so the record carries ``degraded: false`` with
@@ -81,6 +96,10 @@ _ROW_REQUIRED = {
     # 1.0 while the row's replica_availability records the capacity
     # actually lost to the injected death (< 1.0).
     "availability": (int, float),
+    # replica parallel layout ("" = single-chip, "tp=2" = 2-way tensor
+    # sharding — ServeConfig.serve_layout); fleet rows report the
+    # layout their replicas ran
+    "serve_layout": str,
 }
 
 
@@ -121,6 +140,7 @@ def _zero_doc():
     row.update(
         family="llama",
         kv_quant="none",
+        serve_layout="",
         ttft_s={"mean": 0.0, "p50": 0.0, "p99": 0.0},
     )
     return {
@@ -137,7 +157,7 @@ def _zero_doc():
 
 
 def run_row(params, cfg, max_batch, n_requests, prompt_len, max_new,
-            kv_quant="none"):
+            kv_quant="none", serve_layout=""):
     import numpy as np
 
     from fms_fsdp_tpu.serve import ServeConfig, ServingEngine
@@ -146,6 +166,7 @@ def run_row(params, cfg, max_batch, n_requests, prompt_len, max_new,
         max_batch=max_batch,
         max_seq_len=SEQ,
         kv_quant=kv_quant,
+        serve_layout=serve_layout,
     )
     eng = ServingEngine(params, cfg, scfg)
     rng = np.random.default_rng(0)
@@ -176,6 +197,7 @@ def run_row(params, cfg, max_batch, n_requests, prompt_len, max_new,
         "max_new_tokens": max_new,
         "page_size": eng.page_size,
         "kv_quant": kv_quant,
+        "serve_layout": serve_layout,
         "tokens_per_sec": round(tok_s, 1),
         "ttft_s": {
             "mean": round(sum(ttfts) / max(1, len(ttfts)), 4),
@@ -198,14 +220,10 @@ def run_row(params, cfg, max_batch, n_requests, prompt_len, max_new,
     }
 
 
-def run_fleet_row(model_cfg_dict):
-    """The ``fleet-under-churn`` row: a 2-replica fleet over the same
-    model, with one replica hard-killed mid-stream (the chaos-soak kill
-    schedule). Throughput and p99 here are END-TO-END under churn —
-    relaunch downtime and requeue recompute included — and the row
-    carries both availabilities: per-request (completed/submitted,
-    1.0 by the zero-drop contract) and replica (ledger-folded
-    capacity, measured < 1.0)."""
+def _run_fleet(model_cfg_dict, wave, faults="", n_replicas=2, prefill=0,
+               prefix="bench_fleet_"):
+    """Drive one fleet over ``wave`` ([(prompt, max_new), ...]).
+    Returns (records_in_submit_order, stats, wall_s)."""
     import tempfile
     import time as _time
 
@@ -222,16 +240,18 @@ def run_fleet_row(model_cfg_dict):
         "prefill_bucket": 8,
         "max_prefill_per_step": 1,
     }
-    wdir = tempfile.mkdtemp(prefix="bench_fleet_")
+    wdir = tempfile.mkdtemp(prefix=prefix)
     spawn = make_subprocess_spawn(
         wdir,
         model_cfg_dict,
         serve_cfg,
         init_seed=0,
-        faults="replica_kill:replica=1:step=12:times=1",
+        faults=faults,
+        prefill_replicas=prefill,
     )
     cfg = FleetConfig(
-        n_replicas=2,
+        n_replicas=n_replicas,
+        prefill_replicas=prefill,
         max_seq_len=SEQ,
         max_inflight_per_replica=BATCH,
         stall_timeout_s=30.0,
@@ -239,35 +259,39 @@ def run_fleet_row(model_cfg_dict):
         restart_backoff_s=0.2,
         ledger_path=os.path.join(wdir, "ledger.json"),
     )
-    import numpy as np
-
-    rng = np.random.default_rng(0)
-    prompts = rng.integers(
-        0, model_cfg_dict["src_vocab_size"], size=(REQUESTS, PROMPT)
-    )
     router = FleetRouter(spawn, cfg)
     router.start()
     t0 = _time.monotonic()
-    rids = [router.submit(p.tolist(), NEW) for p in prompts]
+    rids = [router.submit(p, n) for p, n in wave]
     router.run_until_idle(timeout_s=600.0)
     wall = _time.monotonic() - t0
     stats = router.stats()
     router.drain()
     router.shutdown()
-    recs = [router.journal.records[r] for r in rids]
+    return [router.journal.records[r] for r in rids], stats, wall
+
+
+def _fleet_row(mode, recs, stats, wall, ttft_recs=None):
+    """Shared row shape for fleet benches. ``ttft_recs`` narrows the
+    TTFT percentiles to a sub-wave (the short requests of the mixed
+    wave); latency and throughput always cover the whole wave."""
     lats = [r.latency for r in recs if r.latency is not None]
-    ttfts = [r.engine_ttft for r in recs if r.engine_ttft is not None]
+    ttfts = [
+        r.engine_ttft for r in (ttft_recs or recs)
+        if r.engine_ttft is not None
+    ]
     gen = sum(len(r.tokens) for r in recs if r.tokens)
     completed = sum(r.state == "completed" for r in recs)
     return {
-        "mode": "fleet-under-churn",
+        "mode": mode,
         "family": "llama",
         "max_batch": BATCH,
-        "requests": REQUESTS,
+        "requests": len(recs),
         "prompt_len": PROMPT,
         "max_new_tokens": NEW,
-        "page_size": serve_cfg["page_size"],
+        "page_size": 16,
         "kv_quant": "none",
+        "serve_layout": "",
         "tokens_per_sec": round(gen / wall, 1) if wall else 0.0,
         "ttft_s": {
             "mean": round(sum(ttfts) / max(1, len(ttfts)), 4),
@@ -286,6 +310,72 @@ def run_fleet_row(model_cfg_dict):
         "restarts": int(stats["restarts"]),
         "requests_requeued": int(stats["requests_requeued"]),
     }
+
+
+def run_fleet_row(model_cfg_dict):
+    """The ``fleet-under-churn`` row: a 2-replica fleet over the same
+    model, with one replica hard-killed mid-stream (the chaos-soak kill
+    schedule). Throughput and p99 here are END-TO-END under churn —
+    relaunch downtime and requeue recompute included — and the row
+    carries both availabilities: per-request (completed/submitted,
+    1.0 by the zero-drop contract) and replica (ledger-folded
+    capacity, measured < 1.0)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    wave = [
+        (p.tolist(), NEW)
+        for p in rng.integers(
+            0, model_cfg_dict["src_vocab_size"], size=(REQUESTS, PROMPT)
+        )
+    ]
+    recs, stats, wall = _run_fleet(
+        model_cfg_dict, wave,
+        faults="replica_kill:replica=1:step=12:times=1",
+    )
+    return _fleet_row("fleet-under-churn", recs, stats, wall)
+
+
+def run_disagg_rows(model_cfg_dict):
+    """``fleet-unified`` vs ``fleet-disagg``: the same mixed wave —
+    short prompts with long-prompt interferers submitted up front — on
+    3 unified replicas vs 1 prefill + 2 decode. Both rows' ``ttft_s``
+    covers the SHORT requests only: the pair is the measured answer to
+    "what does moving interferer prefill off the decode path buy p99
+    TTFT". The disagg row adds the handoff ledger."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    vocab = model_cfg_dict["src_vocab_size"]
+    long_len = min(4 * PROMPT, SEQ - NEW - 1)
+    wave, short_idx = [], []
+    # interferers first: they own the prefill path when the shorts land
+    for _ in range(max(2, REQUESTS // 4)):
+        wave.append(
+            (rng.integers(0, vocab, size=long_len).tolist(), NEW)
+        )
+    for _ in range(REQUESTS):
+        short_idx.append(len(wave))
+        wave.append((rng.integers(0, vocab, size=8).tolist(), NEW))
+
+    rows = []
+    for mode, prefill in (("fleet-unified", 0), ("fleet-disagg", 1)):
+        recs, stats, wall = _run_fleet(
+            model_cfg_dict, wave, n_replicas=3, prefill=prefill,
+            prefix=f"bench_{mode.replace('-', '_')}_",
+        )
+        row = _fleet_row(
+            mode, recs, stats, wall,
+            ttft_recs=[recs[i] for i in short_idx],
+        )
+        row["prompt_len"] = 8  # the TTFT-bearing sub-wave
+        row["interferer_prompt_len"] = long_len
+        row["interferers"] = len(wave) - len(short_idx)
+        row["prefill_replicas"] = int(stats["prefill_replicas"])
+        row["requests_handed_off"] = int(stats["requests_handed_off"])
+        row["handoff_bytes"] = int(stats["handoff_bytes"])
+        rows.append(row)
+    return rows
 
 
 def bench_model_cfg(family):
@@ -340,6 +430,17 @@ def main():
             raise SystemExit(1)
         return
 
+    # the sharded row needs a multi-device mesh: on a TPU-less host,
+    # force 8 host-platform CPU devices (must precede the jax import;
+    # a no-op for non-CPU backends, which ignore the host platform)
+    if "--xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        )
+
     import jax
 
     from fms_fsdp_tpu.serve.families import init_params_for
@@ -376,12 +477,20 @@ def main():
             # oversubscribed: 2x the requests on the same batch — queue
             # wait lands in TTFT, the continuous-batching stress shape
             run_row(p, cfg, BATCH, 2 * REQUESTS, PROMPT, NEW),
+            # tp=2-sharded replica: the same steady-state wave with
+            # params + KV pools split over a 2-device serving mesh
+            # (docs/serving.md "Sharded replicas & disaggregation")
+            run_row(p, cfg, BATCH, REQUESTS, PROMPT, NEW,
+                    serve_layout="tp=2"),
             # 2-replica fleet with one replica killed mid-stream: the
             # serving numbers under churn (docs/serving.md "Fleet
             # resilience"; the same schedule
             # scripts/chaos_soak_serving.py asserts zero-drop token
             # parity on)
             run_fleet_row(dataclasses.asdict(cfg)),
+            # unified vs disaggregated fleets on the mixed wave: the
+            # short-request p99-TTFT pair
+            *run_disagg_rows(dataclasses.asdict(cfg)),
         ]
     backend = jax.default_backend()
     result = {

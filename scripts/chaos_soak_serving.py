@@ -31,7 +31,16 @@ divergent recompute), the restart ledger shows >= 1 relaunch with
 MEASURED < 1.0 (the churn happened) while per-request completion stays
 1.0 (nothing was dropped). The stall run must additionally detect >= 1
 stall via the watchdog. The fleet stats map is validated against the
-obs schema ``serving_fleet`` field (v12).
+obs schema ``serving_fleet`` field (v13).
+
+``--disagg`` swaps the schedule for a disaggregated fleet (1 prefill +
+2 decode replicas, ``FleetConfig.prefill_replicas``): the same wave
+runs against the unified reference, then twice faulted — the prefill
+worker killed mid-handoff (un-journaled rids requeue as fresh prompts
+and re-prefill) and a decode worker killed post-handoff (journaled
+KV-page bytes replay as ``resume`` on the sibling). Both must complete
+every request with tokens identical to the unified fleet's — the
+end-to-end proof that handoff pages ship bit-exact.
 
 Writes ``fleet_soak.json`` (summary) plus per-incarnation replica
 stderr logs and the request journal / restart ledger under ``--out``.
@@ -127,9 +136,11 @@ def make_wave(n, seed):
     return wave
 
 
-def run_fleet(tag, workdir, faults=""):
+def run_fleet(tag, workdir, faults="", n_replicas=2, prefill=0):
     """One fleet run over the wave. Returns (tokens_by_rid, stats,
-    ledger, wall_s)."""
+    ledger, wall_s). ``prefill`` > 0 turns the fleet disaggregated:
+    replicas [0, prefill) run role=prefill, the rest role=decode, and
+    the router journals each KV-page handoff before forwarding."""
     wdir = os.path.join(workdir, tag)
     spawn = make_subprocess_spawn(
         wdir,
@@ -138,9 +149,11 @@ def run_fleet(tag, workdir, faults=""):
         init_seed=SEED,
         faults=faults,
         env_extra={"JAX_PLATFORMS": "cpu"},
+        prefill_replicas=prefill,
     )
     cfg = FleetConfig(
-        n_replicas=2,
+        n_replicas=n_replicas,
+        prefill_replicas=prefill,
         max_seq_len=SERVE_CFG["max_seq_len"],
         max_inflight_per_replica=4,
         # above the worst single-step wall on CPU (a residual jit
@@ -204,7 +217,7 @@ def assert_faulted(tag, ref_tokens, tokens, stats, ledger):
 
 def validate_obs_map(stats):
     """The fleet stats map must satisfy the obs serving_fleet field on
-    a schema-valid record (v12)."""
+    a schema-valid record (v13)."""
     from fms_fsdp_tpu.obs.schema import (
         SCHEMA_FIELDS,
         SCHEMA_VERSION,
@@ -222,6 +235,89 @@ def validate_obs_map(stats):
     assert not errs, errs
 
 
+def _journal_handoffs(workdir, tag):
+    """Count journaled ``handoff`` events in a run's journal JSONL."""
+    n = 0
+    with open(os.path.join(workdir, tag, "journal.jsonl")) as f:
+        for line in f:
+            if json.loads(line).get("event") == "handoff":
+                n += 1
+    return n
+
+
+def assert_disagg(tag, out, ref_tokens, tokens, stats, ledger):
+    """Disagg-run assertions on top of the shared faulted-run set: the
+    fleet really ran split (every request crossed the prefill->decode
+    wire, journaled first) and the faulted side's loss was absorbed."""
+    assert_faulted(tag, ref_tokens, tokens, stats, ledger)
+    assert stats["prefill_replicas"] == 1.0, stats
+    assert stats["requests_handed_off"] >= N_REQUESTS, (
+        f"[{tag}] only {stats['requests_handed_off']:.0f} handoffs for "
+        f"{N_REQUESTS} requests — the fleet did not run disaggregated"
+    )
+    journaled = _journal_handoffs(out, tag)
+    assert journaled >= N_REQUESTS, (tag, journaled)
+    print(f"[{tag}] handoffs journaled={journaled} "
+          f"bytes={stats['handoff_bytes']:.0f}")
+
+
+def run_disagg_soak(out):
+    """--disagg: a 1-prefill + 2-decode fleet vs the unified reference.
+
+    Token parity of BOTH faulted disagg runs against the unified
+    2-replica fleet is the end-to-end proof that handoff pages are
+    bit-exact (greedy float32/reference decode re-reads the shipped
+    pages verbatim). The two kills land on either side of the wire:
+
+    - **prefill_kill** (replica 0, the only prefill worker, iteration 5
+      of its first incarnation): rids whose handoff bytes never reached
+      the router's journal requeue as FRESH prompts and re-prefill on
+      the relaunched incarnation — mid-handoff loss, zero drops;
+    - **decode_kill** (replica 1, iteration 10): rids already past the
+      journal requeue WITH their handoff bytes and replay as ``resume``
+      on the surviving decode sibling — the prefill worker is never
+      re-consulted post-handoff.
+    """
+    ref_tokens, ref_stats, _, _ = run_fleet("reference", out)
+    assert ref_stats["restarts"] == 0, "reference run must be unfaulted"
+    assert ref_stats["requests_handed_off"] == 0.0, ref_stats
+
+    pk_tokens, pk_stats, pk_ledger, _ = run_fleet(
+        "prefill_kill", out,
+        faults="replica_kill:replica=0:step=5:times=1",
+        n_replicas=3, prefill=1,
+    )
+    assert_disagg("prefill_kill", out, ref_tokens, pk_tokens, pk_stats,
+                  pk_ledger)
+
+    dk_tokens, dk_stats, dk_ledger, _ = run_fleet(
+        "decode_kill", out,
+        faults="replica_kill:replica=1:step=10:times=1",
+        n_replicas=3, prefill=1,
+    )
+    assert_disagg("decode_kill", out, ref_tokens, dk_tokens, dk_stats,
+                  dk_ledger)
+
+    validate_obs_map(pk_stats)
+
+    summary = {
+        "family": FAMILY,
+        "mode": "disagg",
+        "requests": N_REQUESTS,
+        "reference": ref_stats,
+        "prefill_kill": pk_stats,
+        "decode_kill": dk_stats,
+        "zero_drops": True,
+        "token_parity": True,
+    }
+    with open(os.path.join(out, "fleet_soak_disagg.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print("disagg chaos soak PASSED: zero drops, token parity vs "
+          "unified, prefill-kill availability "
+          f"{pk_stats['availability']:.4f}, decode-kill availability "
+          f"{dk_stats['availability']:.4f}")
+
+
 def main():
     global MODEL_CFG, FAMILY
     ap = argparse.ArgumentParser(description=__doc__)
@@ -231,11 +327,23 @@ def main():
                     choices=sorted(MODEL_CFGS),
                     help="fleet model family: llama (paged KV) or "
                          "hybrid mamba (slab + one attn layer)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="soak a disaggregated fleet (1 prefill + 2 "
+                         "decode replicas, journaled KV-page handoff) "
+                         "with kills on either side of the wire, "
+                         "instead of the unified kill/stall schedule")
     args = ap.parse_args()
     MODEL_CFG = MODEL_CFGS[args.family]
     FAMILY = args.family
+    if args.disagg and args.family != "llama":
+        ap.error("--disagg requires --family llama (mamba's slab state "
+                 "has no page handoff; its adapter is unified-only)")
     out = args.out or tempfile.mkdtemp(prefix=f"fleet_soak_{FAMILY}_")
     os.makedirs(out, exist_ok=True)
+    if args.disagg:
+        print(f"disagg serving chaos soak ({FAMILY} fleet) -> {out}")
+        run_disagg_soak(out)
+        return
     print(f"serving chaos soak ({FAMILY} fleet) -> {out}")
 
     ref_tokens, ref_stats, _, ref_wall = run_fleet("reference", out)

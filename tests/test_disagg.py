@@ -1,0 +1,698 @@
+"""Disaggregated serving + sharded replicas (serve/disagg/,
+ServeConfig.serve_layout; docs/serving.md "Sharded replicas &
+disaggregation").
+
+Anchors, per the PR-18 contract:
+
+- PageHandoff wire bytes are deterministic ACROSS PROCESSES (canonical
+  header JSON + sorted C-order leaves, pinned by a subprocess sha256
+  under different PYTHONHASHSEEDs) and round-trip bit-exact for
+  fp32/bf16/int8/fp8 page leaves — quantized pages ship as stored,
+  never widened;
+- unpacking into a FRESH pool preserves the allocator's reserved-page
+  invariants: the zero page stays exactly zero (it is the bit-parity
+  root every short sequence reads through) and the scratch page is
+  untouched;
+- greedy prefill->handoff->decode across two engines is token-for-token
+  identical to one unified engine (llama and mixtral, plus quantized
+  pools), and a decode-side eviction after import falls back to
+  recompute-on-resume correctly;
+- a tp/fsdp-sharded replica (serve_layout, multi-device CPU mesh via
+  conftest's forced 8 devices) serves greedy streams token-identical to
+  the single-chip engine, with params and KV pools actually sharded;
+- the fleet router journals handoffs before forwarding (crash on either
+  side of a half-shipped handoff requeues exactly-once), dispatches
+  fresh rids to prefill replicas and handoff-carrying rids to decode
+  replicas;
+- mamba rejects layouts and non-unified roles with actionable errors;
+- serving_stats carries the schema-v13 fields and validates.
+"""
+
+import base64
+import hashlib
+import json
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from fms_fsdp_tpu.models.configs import LlamaConfig, MixtralConfig
+from fms_fsdp_tpu.models.llama import init_llama_params
+from fms_fsdp_tpu.models.mixtral import init_mixtral_params
+from fms_fsdp_tpu.obs.schema import validate_record
+from fms_fsdp_tpu.parallel.sharding import (
+    parse_serve_layout,
+    serve_layout_code,
+)
+from fms_fsdp_tpu.serve.disagg import (
+    ROLE_CODES,
+    HandoffError,
+    pack_handoff,
+    unpack_handoff,
+)
+from fms_fsdp_tpu.serve.engine import ServeConfig, ServingEngine
+from fms_fsdp_tpu.serve.fleet import FleetConfig, FleetRouter
+from fms_fsdp_tpu.serve.kv_cache import (
+    RESERVED_PAGES,
+    SCRATCH_PAGE,
+    ZERO_PAGE,
+    PagedKVCache,
+)
+from fms_fsdp_tpu.serve.scheduler import RequestRejected
+
+TINY = LlamaConfig(
+    src_vocab_size=128, emb_dim=64, nheads=4, kvheads=2, nlayers=2,
+    max_expected_seq_len=256,
+)
+TINY_MIXTRAL = MixtralConfig(
+    src_vocab_size=128, emb_dim=64, nheads=4, kvheads=2, nlayers=2,
+    hidden_dim=128, num_experts=4, top_k=2, max_expected_seq_len=64,
+)
+PROMPTS = [[3, 5, 7], [11, 13, 17, 19], [2]]
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return init_llama_params(jax.random.PRNGKey(0), TINY)
+
+
+@pytest.fixture(scope="module")
+def mixtral_params():
+    return init_mixtral_params(jax.random.PRNGKey(2), TINY_MIXTRAL)
+
+
+def _scfg(**kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("compute_dtype", "float32")
+    kw.setdefault("attn_impl", "reference")
+    kw.setdefault("page_size", 8)
+    return ServeConfig(**kw)
+
+
+def _serve_all(engine, prompts, max_new=6):
+    reqs = [engine.submit(p, max_new) for p in prompts]
+    engine.run()
+    return reqs
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+
+def _leaf(dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    return a.astype(dtype)
+
+
+@pytest.mark.parametrize(
+    "dtype", ["float32", "bfloat16", "int8", "float8_e4m3fn"]
+)
+def test_pack_unpack_bit_exact_per_dtype(dtype):
+    import ml_dtypes
+
+    np_dtype = {
+        "float32": np.float32,
+        "bfloat16": ml_dtypes.bfloat16,
+        "int8": np.int8,
+        "float8_e4m3fn": ml_dtypes.float8_e4m3fn,
+    }[dtype]
+    arrays = {"k": _leaf(np_dtype, 0), "v": _leaf(np_dtype, 1)}
+    header = {"family": "llama", "quant": "none", "seq_len": 3}
+    wire = pack_handoff(header, arrays)
+    h2, a2 = unpack_handoff(wire)
+    assert h2["family"] == "llama" and h2["seq_len"] == 3
+    for name in arrays:
+        assert a2[name].dtype == np.dtype(np_dtype)
+        # bit-exact: compare raw bytes, not values (NaN-safe, and the
+        # contract is the STORAGE bits, not float equality)
+        assert a2[name].tobytes() == np.ascontiguousarray(
+            arrays[name]
+        ).tobytes()
+
+
+def test_pack_deterministic_across_processes(tmp_path):
+    """Two fresh interpreters with different PYTHONHASHSEEDs must emit
+    identical wire bytes for the same state — the canonical-JSON +
+    sorted-leaf contract, not an accident of dict ordering."""
+    prog = r"""
+import hashlib, sys
+import numpy as np
+from fms_fsdp_tpu.serve.disagg import pack_handoff
+arrays = {
+    "v": (np.arange(24, dtype=np.float32) / 7).reshape(2, 3, 4),
+    "k": (np.arange(24, dtype=np.float32) * 3).reshape(2, 3, 4),
+}
+header = {"zeta": 1, "alpha": [1, 2, 3], "quant": "none"}
+sys.stdout.write(hashlib.sha256(pack_handoff(header, arrays)).hexdigest())
+"""
+    digests = set()
+    for seed in ("0", "1", "31337"):
+        out = subprocess.run(
+            [sys.executable, "-c", prog],
+            capture_output=True, text=True,
+            env={
+                "PYTHONHASHSEED": seed,
+                "PATH": "/usr/bin:/bin",
+                "PYTHONPATH": ":".join(sys.path),
+            },
+            check=True,
+        )
+        digests.add(out.stdout.strip())
+    assert len(digests) == 1, digests
+
+
+def test_unpack_rejects_corruption():
+    wire = pack_handoff({"x": 1}, {"k": _leaf(np.float32)})
+    with pytest.raises(HandoffError, match="magic"):
+        unpack_handoff(b"NOPE" + wire[4:])
+    with pytest.raises(HandoffError, match="checksum"):
+        flipped = bytearray(wire)
+        flipped[len(wire) // 2] ^= 0xFF
+        unpack_handoff(bytes(flipped))
+    with pytest.raises(HandoffError, match="magic"):
+        unpack_handoff(b"FMSH")  # truncated below any valid frame
+    with pytest.raises(HandoffError, match="checksum"):
+        unpack_handoff(wire[:-5] + wire[-4:])  # torn leaf tail
+    # version check: patch the u16 and re-crc
+    import struct
+    import zlib
+
+    body = bytearray(wire[:-4])
+    struct.pack_into("<H", body, 4, 99)
+    bad = bytes(body) + struct.pack(
+        "<I", zlib.crc32(bytes(body)) & 0xFFFFFFFF
+    )
+    with pytest.raises(HandoffError, match="version 99"):
+        unpack_handoff(bad)
+
+
+def test_scatter_into_fresh_pool_preserves_reserved_pages(tiny_params):
+    """Gather a live sequence's pages, scatter into a FRESH pool: the
+    landed values are bit-exact and the reserved pages keep their
+    invariants — zero page exactly zero (the bit-parity root), scratch
+    page untouched."""
+    eng = ServingEngine(tiny_params, TINY, _scfg())
+    _serve_all(eng, PROMPTS, max_new=4)
+    # re-serve one stream and freeze it mid-flight to gather live pages
+    req = eng.submit([23, 29, 31], 8)
+    eng.step()  # prefilled, 1 token generated
+    src = eng.cache
+    gathered = src.gather_pages(req.rid)
+    assert set(gathered) == set(src.pools)
+
+    fresh = PagedKVCache(
+        src.n_layers, src.num_pages, src.page_size, src.n_kv_heads,
+        src.head_dim, dtype=src.pools["k"].dtype, quant=src.quant,
+    )
+    scratch_before = {
+        n: np.asarray(p[:, SCRATCH_PAGE]) for n, p in fresh.pools.items()
+    }
+    ok = fresh.scatter_pages(req.rid, gathered, src.tokens_of(req.rid))
+    assert ok
+    for name, pool in fresh.pools.items():
+        np.testing.assert_array_equal(
+            np.asarray(pool[:, fresh._seq_pages[req.rid]]),
+            np.asarray(gathered[name]),
+        )
+        assert not np.asarray(pool[:, ZERO_PAGE]).any(), (
+            f"{name}: zero page dirtied by scatter"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pool[:, SCRATCH_PAGE]), scratch_before[name]
+        )
+    assert fresh.tokens_of(req.rid) == src.tokens_of(req.rid)
+    assert fresh.pages_in_use == len(fresh._seq_pages[req.rid])
+
+
+# ---------------------------------------------------------------------------
+# engine-level disaggregation
+# ---------------------------------------------------------------------------
+
+
+def _disagg_tokens(params, cfg, scfg_kw, prompts, max_new=6):
+    pe = ServingEngine(params, cfg, _scfg(role="prefill", **scfg_kw))
+    de = ServingEngine(params, cfg, _scfg(role="decode", **scfg_kw))
+    preqs = _serve_all(pe, prompts, max_new)
+    wires = [r.handoff_out for r in preqs]
+    assert all(w is not None for w in wires)
+    dreqs = [de.submit_handoff(w) for w in wires]
+    de.run()
+    return [list(r.generated) for r in dreqs], wires, pe, de
+
+
+@pytest.mark.parametrize("kv_quant", ["none", "int8", "fp8"])
+def test_disagg_greedy_parity_llama(tiny_params, kv_quant):
+    kw = {"kv_quant": kv_quant}
+    uni = ServingEngine(tiny_params, TINY, _scfg(**kw))
+    baseline = [
+        list(r.generated) for r in _serve_all(uni, PROMPTS)
+    ]
+    got, wires, pe, de = _disagg_tokens(tiny_params, TINY, kw, PROMPTS)
+    assert got == baseline
+    if kv_quant != "none":
+        # quantized pages ship quantized: scale leaves present on the
+        # wire, and the page leaf is the 1-byte storage dtype
+        h, arrays = unpack_handoff(wires[0])
+        assert h["quant"] == kv_quant
+        assert {"k", "v", "k_scale", "v_scale"} == set(arrays)
+        assert arrays["k"].dtype.itemsize == 1
+
+
+def test_disagg_greedy_parity_mixtral(mixtral_params):
+    kw = {"page_size": 16, "moe_impl": "dense"}
+    uni = ServingEngine(mixtral_params, TINY_MIXTRAL, _scfg(**kw))
+    baseline = [list(r.generated) for r in _serve_all(uni, PROMPTS)]
+    got, _, _, _ = _disagg_tokens(
+        mixtral_params, TINY_MIXTRAL, kw, PROMPTS
+    )
+    assert got == baseline
+
+
+def test_disagg_wire_deterministic_and_restartable(tiny_params):
+    """The same prefill twice emits identical bytes, and the SAME wire
+    bytes resumed on two decode engines yield identical streams — the
+    property the router's journaled-requeue replay rides on."""
+    _, wires1, _, _ = _disagg_tokens(tiny_params, TINY, {}, PROMPTS)
+    _, wires2, _, _ = _disagg_tokens(tiny_params, TINY, {}, PROMPTS)
+    assert wires1 == wires2
+    d1 = ServingEngine(tiny_params, TINY, _scfg(role="decode"))
+    d2 = ServingEngine(tiny_params, TINY, _scfg(role="decode"))
+    r1 = d1.submit_handoff(wires1[1])
+    r2 = d2.submit_handoff(wires1[1])
+    d1.run()
+    d2.run()
+    assert list(r1.generated) == list(r2.generated)
+
+
+def test_decode_side_eviction_recomputes_after_import(tiny_params):
+    """After a handoff import, eviction falls back to the standard
+    recompute-on-resume (handoff_in was consumed): the stream still
+    finishes with the unified engine's tokens."""
+    uni = ServingEngine(tiny_params, TINY, _scfg(max_batch=2))
+    baseline = [
+        list(r.generated) for r in _serve_all(uni, PROMPTS[:2], 8)
+    ]
+    pe = ServingEngine(tiny_params, TINY, _scfg(role="prefill"))
+    preqs = _serve_all(pe, PROMPTS[:2], 8)
+    # tiny pool: 2 slots' worst case cannot coexist -> evictions
+    de = ServingEngine(
+        tiny_params, TINY,
+        _scfg(role="decode", max_batch=2, num_pages=2 + RESERVED_PAGES),
+    )
+    dreqs = [de.submit_handoff(r.handoff_out) for r in preqs]
+    de.run()
+    assert [list(r.generated) for r in dreqs] == baseline
+    assert de.scheduler.evicted >= 1, "pool was sized to force eviction"
+
+
+def test_handoff_header_mismatch_is_typed(tiny_params):
+    pe = ServingEngine(tiny_params, TINY, _scfg(role="prefill"))
+    wire = _serve_all(pe, [PROMPTS[0]])[0].handoff_out
+    de = ServingEngine(
+        tiny_params, TINY, _scfg(role="decode", page_size=16)
+    )
+    with pytest.raises(HandoffError, match="page_size"):
+        de.submit_handoff(wire)
+
+
+def test_prefill_handoff_max_bytes_rejects_typed(tiny_params):
+    pe = ServingEngine(
+        tiny_params, TINY, _scfg(role="prefill", handoff_max_bytes=64)
+    )
+    with pytest.raises(RequestRejected) as ei:
+        pe.submit(list(range(32)), 4)
+    assert ei.value.reason == "too_large"
+    assert "handoff_max_bytes" in str(ei.value)
+
+
+def test_mamba_rejects_roles_and_layouts():
+    from fms_fsdp_tpu.models.configs import MambaConfig
+    from fms_fsdp_tpu.models.mamba import init_mamba_params
+
+    cfg = MambaConfig(
+        d_model=64, n_layer=2, vocab_size=128, d_state=16, headdim=16,
+        chunk_size=8, attn_layer_idx=(), d_intermediate=128,
+    )
+    params = init_mamba_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="unified"):
+        ServingEngine(
+            params, cfg, _scfg(role="prefill", kv_quant="none")
+        )
+    with pytest.raises(ValueError, match="single-chip"):
+        ServingEngine(
+            params, cfg, _scfg(serve_layout="tp=2", kv_quant="none")
+        )
+    with pytest.raises(ValueError, match="unknown serving role"):
+        ServingEngine(params, cfg, _scfg(role="prefix"))
+
+
+# ---------------------------------------------------------------------------
+# sharded replicas (serve_layout on the 8-device CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("layout", ["tp=2", "tp=2,fsdp=2"])
+def test_sharded_replica_token_parity(tiny_params, layout):
+    uni = ServingEngine(tiny_params, TINY, _scfg())
+    baseline = [list(r.generated) for r in _serve_all(uni, PROMPTS)]
+    sh = ServingEngine(tiny_params, TINY, _scfg(serve_layout=layout))
+    got = [list(r.generated) for r in _serve_all(sh, PROMPTS)]
+    assert got == baseline
+    n_dev = parse_serve_layout(layout)
+    n_dev = n_dev["tensor"] * n_dev["fsdp"]
+    assert sh.adapter.mesh is not None
+    assert len(sh.adapter.mesh.devices.flat) == n_dev
+    # params actually span the mesh (wq sharded over its device set)
+    wq = sh.adapter.params["layers"]["wq"]
+    assert len(wq.sharding.device_set) == n_dev
+    # KV pools sharded over the kv-head axis on the tensor dim
+    k = sh.cache.pools["k"]
+    assert len(k.sharding.device_set) == n_dev
+
+
+def test_sharded_mixtral_token_parity(mixtral_params):
+    kw = {"page_size": 16, "moe_impl": "dense"}
+    uni = ServingEngine(mixtral_params, TINY_MIXTRAL, _scfg(**kw))
+    baseline = [list(r.generated) for r in _serve_all(uni, PROMPTS)]
+    sh = ServingEngine(
+        mixtral_params, TINY_MIXTRAL, _scfg(serve_layout="tp=2", **kw)
+    )
+    got = [list(r.generated) for r in _serve_all(sh, PROMPTS)]
+    assert got == baseline
+
+
+def test_sharded_disagg_compose(tiny_params):
+    """Layout and role compose: a sharded prefill engine hands off to a
+    sharded decode engine, token-identical to single-chip unified."""
+    uni = ServingEngine(tiny_params, TINY, _scfg())
+    baseline = [list(r.generated) for r in _serve_all(uni, PROMPTS)]
+    got, _, _, _ = _disagg_tokens(
+        tiny_params, TINY, {"serve_layout": "tp=2"}, PROMPTS
+    )
+    assert got == baseline
+
+
+def test_parse_serve_layout_contract():
+    assert parse_serve_layout("") == {"tensor": 1, "fsdp": 1}
+    assert parse_serve_layout("tp=2") == {"tensor": 2, "fsdp": 1}
+    assert parse_serve_layout("tp=2,fsdp=4") == {"tensor": 2, "fsdp": 4}
+    assert serve_layout_code("") == 0
+    assert serve_layout_code("tp=2") == 201
+    assert serve_layout_code("tp=2,fsdp=2") == 202
+    with pytest.raises(ValueError, match="unknown serve_layout axis"):
+        parse_serve_layout("dp=2")
+    with pytest.raises(ValueError):
+        parse_serve_layout("tp=0")
+
+
+# ---------------------------------------------------------------------------
+# obs schema v13
+# ---------------------------------------------------------------------------
+
+
+def test_serving_stats_v13_fields_validate(tiny_params):
+    got, wires, pe, de = _disagg_tokens(tiny_params, TINY, {}, PROMPTS)
+    for eng, role in ((pe, "prefill"), (de, "decode")):
+        st = eng.serving_stats()
+        assert st["role"] == float(ROLE_CODES[role])
+        assert st["serve_layout"] == 0.0
+        assert st["handoff_bytes"] == float(sum(len(w) for w in wires))
+        assert st["handoff_s"] >= 0.0
+    sh = ServingEngine(
+        tiny_params, TINY, _scfg(serve_layout="tp=2,fsdp=2")
+    )
+    assert sh.serving_stats()["serve_layout"] == 202.0
+    # a full obs record carrying the v13 serving map validates
+    from tests.test_obs import _observer_record
+
+    rec = _observer_record()
+    rec["serving"] = de.serving_stats()
+    assert validate_record(rec) == []
+
+
+# ---------------------------------------------------------------------------
+# fleet router disaggregation (fake replicas; subprocess e2e lives in
+# scripts/chaos_soak_serving.py --disagg)
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class DisaggFakeReplica:
+    """Role-aware in-process replica double. Prefill role answers each
+    submit with a ``handoff`` after ``steps_per_req`` ticks; decode
+    role only accepts ``resume`` and emits ``done``."""
+
+    def __init__(self, ctx, role, steps_per_req=2):
+        self.ctx = ctx
+        self.role = role
+        self.out = [{"type": "hb", "iterations": 0, "completed": 0,
+                     "slots_busy": 0, "queue_depth": 0}]
+        self.dead = None
+        self.work = {}
+        self.completed = 0
+        self.steps_per_req = steps_per_req
+        self.got_msgs = []
+
+    def send(self, msg):
+        if self.dead is not None:
+            return False
+        self.got_msgs.append(msg)
+        if msg["type"] == "submit":
+            assert self.role == "prefill", (
+                f"fresh rid routed to a {self.role} replica"
+            )
+            self.work[msg["rid"]] = [
+                self.steps_per_req, msg["max_new_tokens"], None,
+            ]
+        elif msg["type"] == "resume":
+            assert self.role == "decode", (
+                f"handoff routed to a {self.role} replica"
+            )
+            self.work[msg["rid"]] = [
+                self.steps_per_req, msg["max_new_tokens"], msg["data"],
+            ]
+        return True
+
+    def tick(self):
+        if self.dead is not None:
+            return
+        for rid, st in list(self.work.items()):
+            st[0] -= 1
+            if st[0] <= 0:
+                self.completed += 1
+                if self.role == "prefill":
+                    data = base64.b64encode(
+                        f"pages-of-{rid}".encode()
+                    ).decode("ascii")
+                    self.out.append({
+                        "type": "handoff", "rid": rid, "data": data,
+                        "bytes": len(data), "ttft": 0.25,
+                    })
+                else:
+                    self.out.append({
+                        "type": "done", "rid": rid,
+                        "tokens": list(range(st[1])), "ttft": 9.9,
+                    })
+                del self.work[rid]
+        self.out.append({"type": "hb", "iterations": 1,
+                         "completed": self.completed,
+                         "slots_busy": len(self.work), "queue_depth": 0})
+
+    def recv(self):
+        o, self.out = self.out, []
+        return o
+
+    def drain_final(self, timeout_s=1.0):
+        return self.recv()
+
+    def poll(self):
+        return self.dead
+
+    def kill(self):
+        self.dead = -9
+
+    def close(self):
+        pass
+
+
+def _disagg_fleet(clk, n=3, prefill=1, **cfg_kw):
+    replicas = {}
+
+    def spawn(ctx):
+        role = "prefill" if ctx["replica"] < prefill else "decode"
+        r = DisaggFakeReplica(ctx, role)
+        replicas[ctx["replica"]] = r
+        return r
+
+    cfg_kw.setdefault("n_replicas", n)
+    cfg_kw.setdefault("prefill_replicas", prefill)
+    cfg_kw.setdefault("max_seq_len", 64)
+    cfg_kw.setdefault("max_inflight_per_replica", 4)
+    cfg_kw.setdefault("stall_timeout_s", 5.0)
+    cfg_kw.setdefault("restart_backoff_s", 0.1)
+    router = FleetRouter(
+        spawn, FleetConfig(**cfg_kw), clock=clk, log=lambda m: None
+    )
+    return router, replicas
+
+
+def _drive(router, replicas, clk, ticks, dt=0.5, on_tick=None):
+    done = []
+    for i in range(ticks):
+        clk.t += dt
+        for r in replicas.values():
+            r.tick()
+        if on_tick:
+            on_tick(i)
+        done += router.poll()
+    return done
+
+
+def test_router_disagg_happy_path_roles_and_journal(tmp_path):
+    clk = FakeClock()
+    router, replicas = _disagg_fleet(
+        clk, journal_path=str(tmp_path / "j.jsonl")
+    )
+    router.start()
+    rids = [router.submit([1, 2, 3], 4) for _ in range(6)]
+    done = _drive(router, replicas, clk, 40)
+    assert sorted(r.rid for r in done) == rids
+    s = router.stats()
+    assert s["completion_rate"] == 1.0
+    assert s["requests_handed_off"] == 6.0
+    assert s["prefill_replicas"] == 1.0
+    # every fresh rid hit the prefill replica, every resume a decode one
+    assert all(
+        m["type"] in ("submit", "drain")
+        for m in replicas[0].got_msgs
+    )
+    resumes = [
+        m for i in (1, 2) for m in replicas[i].got_msgs
+        if m["type"] == "resume"
+    ]
+    assert len(resumes) == 6
+    # handoff TTFT (prefill side) survives onto the completed record,
+    # the decode side's does not overwrite it
+    assert all(
+        router.journal.records[r].engine_ttft == 0.25 for r in rids
+    )
+    # journaled handoff events precede completion; bytes cleared after
+    events = [json.loads(l)["event"] for l in open(tmp_path / "j.jsonl")]
+    assert events.count("handoff") == 6
+    assert all(
+        router.journal.records[r].handoff is None for r in rids
+    )
+    assert all(
+        router.journal.records[r].handoff_bytes > 0 for r in rids
+    )
+
+
+def test_router_prefill_death_mid_handoff_requeues_prompt(tmp_path):
+    """The prefill worker dies BEFORE its handoff escapes: no bytes
+    were journaled, so the rid requeues as a fresh prompt and
+    re-prefills on the relaunched incarnation. Zero drops."""
+    clk = FakeClock()
+    router, replicas = _disagg_fleet(clk)
+    router.start()
+    rids = [router.submit([1, 2, 3], 4) for _ in range(4)]
+
+    def kill_prefill(i):
+        if i == 1:
+            # drop its un-emitted work and die: handoffs never escape
+            replicas[0].work.clear()
+            replicas[0].out = []
+            replicas[0].dead = 10
+
+    done = _drive(router, replicas, clk, 60, on_tick=kill_prefill)
+    assert sorted(r.rid for r in done) == rids
+    s = router.stats()
+    assert s["completion_rate"] == 1.0
+    assert s["requests_requeued"] >= 1
+    assert s["restarts"] >= 1
+    # the requeued rids re-prefilled: no handoff was journaled for them
+    # at requeue time (rec.handoff was still None)
+    assert router.journal.duplicates_dropped == 0
+
+
+def test_router_decode_death_post_handoff_replays_bytes():
+    """The decode replica dies AFTER the handoff was journaled: the
+    requeue keeps the bytes, and the replay goes to a decode sibling as
+    a ``resume`` carrying the SAME wire bytes — the prefill worker is
+    never re-consulted."""
+    clk = FakeClock()
+    router, replicas = _disagg_fleet(clk, n=3, prefill=1)
+    router.start()
+    rids = [router.submit([1, 2, 3], 4) for _ in range(4)]
+    seen_data = {}
+
+    def snoop_then_kill(i):
+        # once replica 1 (decode) owns resumed work, kill it
+        if replicas[1].work and replicas[1].dead is None:
+            for rid, st in replicas[1].work.items():
+                seen_data[rid] = st[2]
+            replicas[1].dead = 10
+
+    done = _drive(router, replicas, clk, 80, on_tick=snoop_then_kill)
+    assert sorted(r.rid for r in done) == rids
+    assert seen_data, "the kill never fired on owned decode work"
+    s = router.stats()
+    assert s["completion_rate"] == 1.0
+    assert s["requests_requeued"] >= 1
+    # the replayed resume carried the journaled bytes verbatim
+    prefill_submits = [
+        m["rid"] for m in replicas[0].got_msgs if m["type"] == "submit"
+    ]
+    assert sorted(set(prefill_submits)) == rids, (
+        "a decode-side death must not re-prefill"
+    )
+    assert len(prefill_submits) == len(rids)
+    replayed = [
+        m for r in replicas.values() for m in r.got_msgs
+        if m["type"] == "resume" and m["rid"] in seen_data
+    ]
+    for m in replayed:
+        assert m["data"] == seen_data[m["rid"]]
+
+
+def test_router_head_of_line_waits_for_role_pool():
+    """A fresh rid with the prefill pool down waits (no bypass to
+    decode replicas), and dispatches the moment the pool relaunches."""
+    clk = FakeClock()
+    router, replicas = _disagg_fleet(clk, n=2, prefill=1)
+    router.start()
+    clk.t += 0.5
+    for r in replicas.values():
+        r.tick()
+    router.poll()  # both ready
+    replicas[1].dead = None  # keep decode alive
+    replicas[0].dead = 10  # prefill pool down
+    rid = router.submit([1, 2, 3], 4)
+    clk.t += 0.5
+    router.poll()  # death sweep; nothing dispatchable
+    assert router.journal.records[rid].state == "queued"
+    assert all(
+        m["type"] != "submit" for m in replicas[1].got_msgs
+    ), "fresh rid must not bypass to a decode replica"
+    done = _drive(router, replicas, clk, 40)
+    assert [r.rid for r in done] == [rid]
+
+
+def test_fleet_config_prefill_bounds():
+    with pytest.raises(ValueError, match="prefill_replicas"):
+        FleetRouter(
+            lambda ctx: None,
+            FleetConfig(n_replicas=2, prefill_replicas=2),
+            log=lambda m: None,
+        )
