@@ -17,8 +17,9 @@ state variance constant across heads. ``tie_weights`` shares emb/ln/head
 incoming base-model embedding (no affine) scaled by 1/sqrt(2).
 """
 
-from dataclasses import dataclass
-from typing import Any, Dict
+import pickle
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -131,6 +132,38 @@ def head_step(params, scfg: SpeculatorConfig, state, tok, i):
     )
     logits = state @ _pick(params, scfg, "head", i).astype(state.dtype)
     return state, logits
+
+
+def save_speculator(path: str, params: Params, scfg: SpeculatorConfig) -> None:
+    """Write a serving speculator checkpoint: params + config in one
+    pickle. The config MUST ship with the weights — under tie_weights
+    the param tree holds one shared head, so ``n_predict`` (and with it
+    the variance-preserving state/emb weights) is not recoverable from
+    shapes alone."""
+    import numpy as np
+
+    payload = {
+        "model_state": jax.tree.map(np.asarray, params),
+        "speculator_config": asdict(scfg),
+    }
+    with open(path, "wb") as f:
+        pickle.dump(payload, f)
+
+
+def load_speculator(path: str) -> Tuple[Params, SpeculatorConfig]:
+    """Restore a ``save_speculator`` checkpoint -> (params, config)."""
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if "speculator_config" not in payload:
+        raise ValueError(
+            f"{path!r} is not a serving speculator checkpoint: expected "
+            "a save_speculator pickle carrying 'speculator_config' "
+            "alongside 'model_state' (n_predict is not inferrable from "
+            "tied weights)"
+        )
+    scfg = SpeculatorConfig(**payload["speculator_config"])
+    params = jax.tree.map(jnp.asarray, payload["model_state"])
+    return params, scfg
 
 
 def speculator_forward(params: Params, state, inds, scfg: SpeculatorConfig):

@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -120,6 +120,15 @@ SCHEMA_FIELDS = {
     # 0 = single-chip; parallel/sharding.py::serve_layout_code),
     # ``handoff_bytes`` (cumulative PageHandoff wire bytes packed +
     # imported) and ``handoff_s`` (wall seconds packing/scattering).
+    # v14: the map gains the raw-speed fields (docs/observability.md
+    # "v14"): ``spec_accept_rate`` (accepted draft tokens over offered
+    # — 0.0 when speculative serving is off), ``spec_draft_tokens``
+    # (draft tokens per verify step; 0 = non-speculative),
+    # ``prefill_chunks`` (cumulative chunked-prefill slices advanced;
+    # 0 = whole-prompt prefill) and ``paged_kernel_impl`` (0 =
+    # reference gather, 1 = single-page paged-attention kernel v1
+    # path, 2 = kernel v2 engaged — multi-page DMA and/or native
+    # quantized page reads).
     "serving": ("map", False),
     # v11: serving-fleet accounting (docs/serving.md "Fleet
     # resilience"). Flat map from FleetRouter.stats(): replicas /
@@ -215,6 +224,11 @@ SCHEMA_DIGESTS = {
     # serving_fleet map gains prefill_replicas / requests_handed_off /
     # handoff_bytes
     13: "598cbb44447e0667b8655a5b06dc569b2e00b33f748561f2d2ec6d365600418d",
+    # v14: serving map gains spec_accept_rate / spec_draft_tokens
+    # (speculative serving), prefill_chunks (chunked prefill) and
+    # paged_kernel_impl (the kernel generation engaged); the field set
+    # itself is unchanged
+    14: "2f8909a62cde9d1cdfd1d4153c219e37d8f16b8011a7f3dca7feeb5ebb2a567a",
 }
 
 

@@ -31,10 +31,15 @@ Two implementations, one contract:
 
 Tile resolution (page_size at allocator build, block_kv per call) goes
 through the tuning table (fms_fsdp_tpu/tune/lookup.py::
-resolve_paged_decode) like every other kernel. v1 constraint: the
-kernel walks one page per grid step, so ``block_kv == page_size``; the
-cost model already prices larger multi-page blocks (manual-DMA fetch)
-so committed tables stay forward-compatible.
+resolve_paged_decode) like every other kernel. v2 lifts the two v1
+constraints: ``block_kv`` may be any multiple of ``page_size`` (the
+kernel walks ``block_kv // page_size`` pool pages per grid step,
+fetched by manual DMA into a VMEM block since pages are not contiguous
+in the pool), and int8/fp8-quantized pools are read natively — the
+per-page scale blocks ride the same DMA and the dequantize
+(``kv_dequantize``: ``(q * scale) -> compute dtype``) happens in VMEM
+right before the dot, so quantized serving no longer falls back to the
+reference gather.
 """
 
 import functools
@@ -187,18 +192,187 @@ def _paged_decode_kernel(
         o_ref[0, 0] = (acc_ref[...] / safe).astype(o_ref.dtype)
 
 
+def _paged_decode_kernel_v2(
+    lens_ref,  # scalar prefetch: (B,) int32 query positions
+    table_ref,  # scalar prefetch: (B, maxp) int32 page table
+    q_ref,  # (1, 1, group, H)
+    *rest,  # [k, v(, k_scale, v_scale)] HBM refs; o_ref; scratch
+    page_size,
+    pages_per_block,
+    maxp,
+    scale,
+    quantized,
+    compute_dtype,
+):
+    """v2 body: ``pages_per_block`` pool pages per grid cell, fetched by
+    manual DMA (pages are scattered through the pool, so no BlockSpec
+    index map can describe the block); optional per-page scale blocks
+    ride the same DMA and dequantize in VMEM. Online-softmax math is
+    identical to the v1 body above, over a ``block_kv``-wide tile."""
+    if quantized:
+        (k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref,
+         k_buf, v_buf, ks_buf, vs_buf, sem, acc_ref, m_ref, l_ref) = rest
+    else:
+        (k_hbm, v_hbm, o_ref, k_buf, v_buf, sem,
+         acc_ref, m_ref, l_ref) = rest
+        ks_hbm = vs_hbm = ks_buf = vs_buf = None
+
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    j = pl.program_id(2)
+    nblocks = pl.num_programs(2)
+    pos = lens_ref[b]
+    block = pages_per_block * page_size
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    run = j * block <= pos
+
+    @pl.when(run)
+    def _():
+        # fetch the block's pages; a ragged tail block re-fetches the
+        # last table slot for its out-of-range pages — those positions
+        # sit past max_seq and the kpos mask below zeroes them
+        copies = []
+        for i in range(pages_per_block):
+            slot = jnp.minimum(j * pages_per_block + i, maxp - 1)
+            pid = table_ref[b, slot]
+            dst = pl.ds(i * page_size, page_size)
+            pairs = [(k_hbm, k_buf, 0), (v_hbm, v_buf, 1)]
+            if quantized:
+                pairs += [(ks_hbm, ks_buf, 2), (vs_hbm, vs_buf, 3)]
+            for src, buf, s_i in pairs:
+                cp = pltpu.make_async_copy(
+                    src.at[pid, :, h], buf.at[dst], sem.at[s_i, i]
+                )
+                cp.start()
+                copies.append(cp)
+        for cp in copies:
+            cp.wait()
+
+        q = (q_ref[0, 0] * (scale * LOG2E)).astype(q_ref.dtype)  # (G, H)
+        k = k_buf[...]  # (block, H), storage dtype
+        v = v_buf[...]
+        if quantized:
+            # kv_dequantize in VMEM: absmax scale per stored row
+            k = (k.astype(jnp.float32) * ks_buf[...]).astype(compute_dtype)
+            v = (v.astype(jnp.float32) * vs_buf[...]).astype(compute_dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (G, block), base-2 domain
+        kpos = j * block + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos <= pos, s, NEG_INF)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = m_new
+
+    @pl.when(j == nblocks - 1)
+    def _():
+        l = l_ref[...]
+        safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / safe).astype(o_ref.dtype)
+
+
+def _paged_kernel_v2_call(
+    q, k_pages, v_pages, page_table, seq_lens, k_scales, v_scales,
+    block_kv, compute_dtype, interpret
+):
+    b, nq, hd = q.shape
+    _, page_size, nkv, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    group = nq // nkv
+    ppb = block_kv // page_size
+    nblocks = -(-maxp // ppb)
+    quantized = k_scales is not None
+    qg = q.reshape(b, nkv, group, hd)
+
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    in_specs = [
+        pl.BlockSpec((1, 1, group, hd), lambda b_, h_, j_, *_: (b_, h_, 0, 0)),
+        any_spec,
+        any_spec,
+    ]
+    operands = [qg, k_pages, v_pages]
+    n_streams = 2
+    scratch = [
+        pltpu.VMEM((ppb * page_size, hd), k_pages.dtype),
+        pltpu.VMEM((ppb * page_size, hd), v_pages.dtype),
+    ]
+    if quantized:
+        in_specs += [any_spec, any_spec]
+        operands += [k_scales, v_scales]
+        n_streams = 4
+        scratch += [
+            pltpu.VMEM((ppb * page_size, 1), k_scales.dtype),
+            pltpu.VMEM((ppb * page_size, 1), v_scales.dtype),
+        ]
+    scratch += [
+        pltpu.SemaphoreType.DMA((n_streams, ppb)),
+        pltpu.VMEM((group, hd), jnp.float32),
+        pltpu.VMEM((group, 1), jnp.float32),
+        pltpu.VMEM((group, 1), jnp.float32),
+    ]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv, nblocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, group, hd), lambda b_, h_, j_, *_: (b_, h_, 0, 0)
+        ),
+        scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel_v2,
+            page_size=page_size,
+            pages_per_block=ppb,
+            maxp=maxp,
+            scale=hd**-0.5,
+            quantized=quantized,
+            compute_dtype=compute_dtype,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, group, hd), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(seq_lens.astype(jnp.int32), page_table.astype(jnp.int32), *operands)
+    return out.reshape(b, nq * hd)
+
+
 def paged_attention_kernel(
-    q, k_pages, v_pages, page_table, seq_lens, *, interpret=None
+    q, k_pages, v_pages, page_table, seq_lens, *,
+    k_scales=None, v_scales=None, block_kv=None, compute_dtype=None,
+    interpret=None,
 ):
     """Pallas ragged paged-attention decode; contract of
     :func:`paged_attention_reference` (same shapes, same masking rule).
 
-    Grid (B, Nkv, maxp): the page table and row positions ride as scalar
-    prefetch, so each cell's (1, ps, 1, H) k/v block is fetched straight
-    from pool page ``page_table[b, j]`` — clamped onto the last live
-    page for cells past the row's length, which therefore issue no new
-    DMA. Online-softmax state lives in VMEM scratch across the page walk
-    (the ``arbitrary`` grid dim).
+    Grid (B, Nkv, ceil(maxp / pages_per_block)): the page table and row
+    positions ride as scalar prefetch. With ``block_kv == page_size``
+    and full-width pools, each cell's (1, ps, 1, H) k/v block is fetched
+    straight from pool page ``page_table[b, j]`` via the BlockSpec index
+    map (the v1 single-page path, unchanged). With ``block_kv`` a larger
+    multiple of ``page_size``, or quantized pools carrying
+    ``k_scales``/``v_scales`` (per-row absmax, see ops/quant.py), the v2
+    body fetches the block's pages by manual DMA and dequantizes in
+    VMEM. Online-softmax state lives in VMEM scratch across the block
+    walk (the ``arbitrary`` grid dim).
     """
     b, nq, hd = q.shape
     num_pool_pages, page_size, nkv, _ = k_pages.shape
@@ -207,6 +381,20 @@ def paged_attention_kernel(
     scale = hd**-0.5
     if interpret is None:
         interpret = interpret_default()
+    if block_kv is None:
+        block_kv = page_size
+    if block_kv % page_size != 0 or block_kv <= 0:
+        raise ValueError(
+            f"block_kv ({block_kv}) must be a positive multiple of the "
+            f"pool page size ({page_size})"
+        )
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("k_scales and v_scales must be passed together")
+    if k_scales is not None or block_kv != page_size:
+        return _paged_kernel_v2_call(
+            q, k_pages, v_pages, page_table, seq_lens, k_scales, v_scales,
+            block_kv, compute_dtype or q.dtype, interpret
+        )
 
     qg = q.reshape(b, nkv, group, hd)
 
@@ -254,14 +442,20 @@ def paged_attention_kernel(
 
 
 def paged_attention(
-    q, k_pages, v_pages, page_table, seq_lens, *, impl="auto", interpret=None
+    q, k_pages, v_pages, page_table, seq_lens, *, impl="auto",
+    k_scales=None, v_scales=None, block_kv=None, compute_dtype=None,
+    interpret=None,
 ):
     """Ragged paged-attention decode: q (B, Nq, H) against paged k/v
     pools -> (B, Nq*H). ``impl``:
 
     - "reference": gather + dense attend — bit-identical to the dense
-      decode path (the tier-1 parity anchor);
-    - "kernel": the Pallas kernel (interpret mode on CPU);
+      decode path (the tier-1 parity anchor). Quantized pools must be
+      dequantized by the caller (serve/decode.py does) — the scale
+      arguments are a kernel-path contract;
+    - "kernel": the Pallas kernel (interpret mode on CPU) — v2 reads
+      quantized pools natively when scales are passed, and walks
+      ``block_kv // page_size`` pages per grid cell;
     - "auto": kernel on TPU backends, reference elsewhere — CPU serving
       and tests keep dense bit-parity by default.
     """
@@ -273,6 +467,8 @@ def paged_attention(
         )
     if impl == "kernel":
         return paged_attention_kernel(
-            q, k_pages, v_pages, page_table, seq_lens, interpret=interpret
+            q, k_pages, v_pages, page_table, seq_lens,
+            k_scales=k_scales, v_scales=v_scales, block_kv=block_kv,
+            compute_dtype=compute_dtype, interpret=interpret,
         )
     raise ValueError(f"unknown paged attention impl: {impl!r}")

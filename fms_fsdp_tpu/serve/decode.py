@@ -42,6 +42,7 @@ def paged_decode_step(
     compute_dtype=jnp.bfloat16,
     quant: str = "none",
     attn_impl: str = "reference",
+    block_kv=None,
     interpret=None,
 ):
     """One decode step for a ragged batch.
@@ -51,7 +52,10 @@ def paged_decode_step(
     <= seq_lens[b]); page_table (B, max_pages) int32; pools is the
     PagedKVCache.pools dict (leading L dim per leaf). Returns
     (logits (B, V), embeds (B, D), pools) — the paged analog of
-    ``decode_step``'s (logits, embeds, cache).
+    ``decode_step``'s (logits, embeds, cache). Under the kernel impl,
+    quantized pools are read natively (the v2 kernel dequantizes from
+    the scale pools in VMEM) and ``block_kv`` sets the pages-per-cell
+    fetch width.
     """
     params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
     b = tokens.shape[0]
@@ -66,11 +70,6 @@ def paged_decode_step(
     slots = seq_lens % page_size
 
     quantized = quant != "none"
-    if quantized and attn_impl == "kernel":
-        raise NotImplementedError(
-            "the v1 paged-decode kernel reads full-width pools; use "
-            "attn_impl='reference' with quantized page storage"
-        )
 
     def attend(q, layer_pools):
         if attn_impl == "kernel":
@@ -80,6 +79,10 @@ def paged_decode_step(
                 layer_pools["v"],
                 page_table,
                 seq_lens,
+                k_scales=layer_pools.get("k_scale"),
+                v_scales=layer_pools.get("v_scale"),
+                block_kv=block_kv,
+                compute_dtype=compute_dtype,
                 interpret=interpret,
             )[:, None]
         if quantized:
@@ -125,3 +128,96 @@ def paged_decode_step(
     embeds = rms_norm(x, params["norm"], cfg.norm_eps)
     logits = embeds @ params["lm_head"]
     return logits[:, 0], embeds[:, 0], pools
+
+
+def paged_verify_step(
+    params,
+    pools,
+    page_table,
+    seq_lens,
+    tokens,
+    cfg: LlamaConfig,
+    *,
+    page_size: int,
+    compute_dtype=jnp.bfloat16,
+    quant: str = "none",
+    attn_impl: str = "reference",
+    interpret=None,
+):
+    """Score m candidate tokens per row in one ragged forward — the
+    speculative-decoding verify step (models/generation.py::decode_chunk
+    over pages, per-row positions instead of one scalar).
+
+    tokens (B, m) int32: token j of row b is written at cache position
+    ``seq_lens[b] + j`` and attends to positions <= it, exactly the
+    decode_chunk rule, so under the reference impl the per-position
+    logits are bit-identical to feeding the same tokens one at a time
+    through ``paged_decode_step`` — which is what lets the greedy accept
+    rule keep speculative serving token-identical to plain greedy.
+    Returns (logits (B, m, V), embeds (B, m, D), pools). The engine owns
+    rollback: positions past a row's accepted prefix hold stale k/v that
+    the <=pos mask hides until a later write replaces them, so rejecting
+    a draft costs no pool traffic at all.
+
+    Verification attends through the gather path under every impl (the
+    decode kernel is specialized to m=1 queries); the quantized round
+    trip matches paged_decode_step's reference branch.
+    """
+    params = jax.tree.map(lambda a: a.astype(compute_dtype), params)
+    b, m = tokens.shape
+    hd = cfg.head_dim
+    max_seq = page_table.shape[1] * page_size
+    cos, sin = rope_table(max_seq, hd, cfg.rope_theta)
+    positions = (
+        seq_lens[:, None] + jnp.arange(m, dtype=jnp.int32)[None, :]
+    ).astype(jnp.int32)  # (B, m)
+    x = params["embedding"][tokens]  # (B, m, D)
+
+    page_ids = page_table[
+        jnp.arange(b)[:, None], positions // page_size
+    ]  # (B, m)
+    slots = positions % page_size
+
+    quantized = quant != "none"
+
+    def attend(q, layer_pools):
+        if quantized:
+            k = kv_dequantize(
+                gather_pages(layer_pools["k"], page_table),
+                gather_pages(layer_pools["k_scale"], page_table),
+                compute_dtype,
+            )
+            v = kv_dequantize(
+                gather_pages(layer_pools["v"], page_table),
+                gather_pages(layer_pools["v_scale"], page_table),
+                compute_dtype,
+            )
+        else:
+            k = gather_pages(layer_pools["k"], page_table)
+            v = gather_pages(layer_pools["v"], page_table)
+        return gqa_attend(q, k, v, positions)
+
+    def body(x, inp):
+        layer, layer_pools = inp
+        q, k, v = decode_layer_qkv(x, layer, cfg, cos, sin, positions)
+        if quantized:
+            qk, sk = kv_quantize(k, quant)
+            qv, sv = kv_quantize(v, quant)
+            layer_pools = {
+                "k": layer_pools["k"].at[page_ids, slots].set(qk),
+                "v": layer_pools["v"].at[page_ids, slots].set(qv),
+                "k_scale": layer_pools["k_scale"].at[page_ids, slots].set(sk),
+                "v_scale": layer_pools["v_scale"].at[page_ids, slots].set(sv),
+            }
+        else:
+            layer_pools = {
+                "k": layer_pools["k"].at[page_ids, slots].set(k),
+                "v": layer_pools["v"].at[page_ids, slots].set(v),
+            }
+        o = attend(q, layer_pools)
+        return decode_layer_out(x, layer, cfg, o), layer_pools
+
+    x, pools = lax.scan(body, x, (params["layers"], pools))
+    embeds = rms_norm(x, params["norm"], cfg.norm_eps)
+    logits = embeds @ params["lm_head"]
+    return logits, embeds, pools

@@ -31,8 +31,14 @@ Greedy decode on the reference impls is bit-identical to each family's
 jitted dense full-forward walk — the parity anchors
 (tests/test_serving.py, tests/test_serving_families.py). Metrics land
 on the engine's MetricRegistry under ``serve.*`` and fold into the obs
-record's schema-v12 ``serving`` map via
+record's schema-v14 ``serving`` map via
 :meth:`ServingEngine.serving_stats`.
+
+PR 19 raw-speed additions, both parity-preserving: chunked prefill
+(``prefill_chunk_tokens``) streams long prompts in slices interleaved
+with decode, and speculative serving (``speculator_path``) commits
+multiple greedy tokens per verify step through the family adapter's
+``decode_spec``.
 """
 
 import time
@@ -78,6 +84,15 @@ class ServeConfig:
     # which keeps strict dense bit-parity
     prefill_bucket: int = 1
     max_prefill_per_step: int = 1  # prefill-decode interleave bound
+    # chunked prefill: prompts longer than this split into chunk-sized
+    # slices advanced one per engine step, interleaved with decode — a
+    # long prompt no longer head-of-line-blocks every running stream's
+    # next token (the long-prompt p99-TTFT win, scripts/bench_serving).
+    # Chunked logits are bit-identical to whole-prompt prefill
+    # (decode_chunk and prefill run the same attention op-for-op over
+    # the same zero-initialized cache). 0 = whole-prompt, the exact v1
+    # code path
+    prefill_chunk_tokens: int = 0
     # overload protection at admission: queued requests beyond this are
     # rejected typed (RequestRejected reason="overloaded") instead of
     # growing an unbounded queue; 0 = unbounded (the v1 behavior —
@@ -94,6 +109,16 @@ class ServeConfig:
     do_sample: bool = False
     temperature: float = 1.0
     top_k: int = 10
+    # speculative serving: path to a save_speculator checkpoint
+    # (models/speculator.py). When set, llama decode runs a batched
+    # draft-then-verify step — the speculator proposes k tokens per
+    # row, one jitted verify forward scores them, and the longest
+    # greedy-matching prefix commits; the greedy accept rule keeps the
+    # emitted stream token-identical to non-speculative greedy. "" off
+    speculator_path: str = ""
+    # cap on draft tokens per verify step (the checkpoint's n_predict
+    # chain is sliced to this many heads); 0 = use n_predict
+    spec_draft_tokens: int = 0
     # mixtral decode FFN: "routed" gathers each token's top-k experts
     # (O(top_k/E) of the dense FLOPs, within one gather-einsum ulp of
     # dense); "dense" replays the training-path full mixture, which is
@@ -160,6 +185,15 @@ class ServingEngine:
                 f"serve_layout={scfg.serve_layout!r} is not supported "
                 f"for the {self.family} family yet — run it single-chip"
             )
+        if (
+            scfg.prefill_chunk_tokens
+            and not self.adapter.supports_chunked_prefill
+        ):
+            raise ValueError(
+                f"prefill_chunk_tokens={scfg.prefill_chunk_tokens} is "
+                f"not supported for the {self.family} family yet — "
+                f"unset it (whole-prompt prefill)"
+            )
         # back-compat surface (tests, benches, fleet introspection):
         # llama/mixtral expose their PagedKVCache here; pure-mamba has
         # no pages, so cache is None and page_size 0
@@ -191,6 +225,11 @@ class ServingEngine:
         # disaggregation accounting (obs schema v13 serving map)
         self._handoff_bytes = 0  # wire bytes packed out + imported in
         self._handoff_wall = 0.0  # seconds spent packing/scattering
+        # chunked prefill + speculative accounting (obs schema v14)
+        self._chunking: Dict[int, tuple] = {}  # rid -> (req, slot)
+        self._prefill_chunks = 0
+        self._spec_draft_total = 0  # draft tokens offered to verify
+        self._spec_accept_total = 0  # draft tokens accepted
 
     # -- construction ------------------------------------------------------
 
@@ -230,11 +269,17 @@ class ServingEngine:
         an accepted never-fits request would head-of-line-block the
         FIFO queue forever."""
         deadline = None if deadline_s is None else self.clock() + deadline_s
-        if len(prompt) + max_new_tokens > self.serve_cfg.max_seq_len:
+        # a speculative verify step writes up to spec_draft_tokens
+        # positions past the committed length before the accept rule
+        # rolls back — those in-flight draft slots must exist, so the
+        # cache budget tightens by draft-1 tokens
+        slack = max(0, self.adapter.spec_draft_tokens - 1)
+        if len(prompt) + max_new_tokens + slack > self.serve_cfg.max_seq_len:
+            extra = f" + {slack} draft headroom" if slack else ""
             self._reject(
                 REJECT_TOO_LARGE,
                 f"prompt ({len(prompt)}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds max_seq_len "
+                f"({max_new_tokens}){extra} exceeds max_seq_len "
                 f"({self.serve_cfg.max_seq_len})",
             )
         err = self.adapter.admission_error(len(prompt), max_new_tokens)
@@ -329,6 +374,13 @@ class ServingEngine:
                 f"the {self.family} family does not support page "
                 f"handoff — route its requests to unified replicas"
             )
+        if self.adapter.speculative:
+            raise ValueError(
+                "a speculative engine cannot resume handoffs: the "
+                "draft state (the last base hidden state) is not part "
+                "of the page handoff — route resumes to "
+                "non-speculative replicas"
+            )
         header, arrays = unpack_handoff(data)
         self.adapter.check_handoff_header(header)
         prompt = [int(t) for t in header["prompt"]]
@@ -384,11 +436,26 @@ class ServingEngine:
             return
         prompt = req.resume_prompt()
         p = len(prompt)
+        chunk = self.serve_cfg.prefill_chunk_tokens
+        if chunk and p > chunk and self.adapter.supports_chunked_prefill:
+            # chunked prefill: allocate + stage now, advance one chunk
+            # per step() interleaved with decode — the slot is held but
+            # joins the decode batch only once the whole prompt is in
+            self.adapter.prefill_start(req.rid, slot, prompt)
+            self._slots[slot] = req
+            self._chunking[req.rid] = (req, slot)
+            return
         # the adapter allocates the stream's decode state (pages and/or
         # slab slice), runs the family prefill and hands back the (V,)
         # logits row of the last real prompt position; sampling stays
         # here so every family shares one rng stream and one sampler
         row = self.adapter.prefill(req.rid, slot, prompt)
+        self._complete_prefill(req, slot, row, p)
+
+    def _complete_prefill(self, req: Request, slot: int, row, p: int) -> None:
+        """Shared tail of whole-prompt and chunked prefill: sample the
+        first token from the last real prompt position's logits row,
+        record TTFT, promote the stream into the decode batch."""
         self._key, sub = jax.random.split(self._key)
         tok = int(
             sample_token(
@@ -484,6 +551,7 @@ class ServingEngine:
         return True
 
     def _release_slot(self, req: Request, slot: int) -> None:
+        self._chunking.pop(req.rid, None)
         self.adapter.release(req.rid, slot)
         self._slots[slot] = None
         if req in self._admit_order:
@@ -544,27 +612,74 @@ class ServingEngine:
             slot = self._slots.index(None)
             self._prefill_request(got[0], slot)
 
+        # advance each staged chunked prefill by ONE chunk, interleaved
+        # with the decode below: the chunk advance does not consume the
+        # admit budget, so short requests keep admitting (and every
+        # running stream keeps decoding) while a long prompt streams in
+        for rid in list(self._chunking):
+            req, slot = self._chunking[rid]
+            row = self.adapter.prefill_chunk(rid)
+            self._prefill_chunks += 1
+            self.registry.counter("serve.prefill_chunks").add()
+            if row is not None:
+                del self._chunking[rid]
+                self._complete_prefill(
+                    req, slot, row, len(req.resume_prompt())
+                )
+
         # token-granular state growth; evict (LIFO) when the pool is
         # dry. Constant-state families (mamba slab) always grow free —
-        # the loop never spins for them.
+        # the loop never spins for them. Speculative streams reserve
+        # draft headroom: the verify step writes spec_draft_tokens
+        # positions past the committed length before rollback.
+        draft = self.adapter.spec_draft_tokens
         for slot, req in enumerate(self._slots):
-            if req is None:
+            if req is None or req.rid in self._chunking:
                 continue
-            while not self.adapter.grow(req.rid, int(self._lens[slot]) + 1):
+            need = int(self._lens[slot]) + 1 + draft
+            while not self.adapter.grow(req.rid, need):
                 victim = self.scheduler.evict_victim(self._admit_order)
                 assert victim is not None, "no victim but pool exhausted"
                 self._evict(victim)
                 if victim is req:
                     break
 
-        active = [
-            (slot, r) for slot, r in enumerate(self._slots) if r is not None
+        slot_rids = [
+            r.rid if r is not None and r.rid not in self._chunking else None
+            for r in self._slots
         ]
-        if active:
+        active = [
+            (slot, r)
+            for slot, r in enumerate(self._slots)
+            if r is not None and r.rid not in self._chunking
+        ]
+        if active and self.adapter.speculative:
+            t0 = self.clock()
+            emit, counts, logits = self.adapter.decode_spec(
+                slot_rids, self._lens, self._tokens
+            )
+            self.last_logits = logits
+            self._decode_wall += self.clock() - t0
+            for slot, req in active:
+                self._spec_draft_total += draft
+                self._spec_accept_total += int(counts[slot]) - 1
+                # commit the accepted prefix token-by-token: eos and
+                # max_new checks run per token, so truncation matches
+                # the non-speculative stream exactly
+                for j in range(int(counts[slot])):
+                    self._lens[slot] += 1
+                    tok = int(emit[slot, j])
+                    req.generated.append(tok)
+                    self._tokens[slot] = tok
+                    self._decode_tokens += 1
+                    self.registry.counter("serve.decode_tokens").add()
+                    if self._finish_if_done(req, slot):
+                        break
+        elif active:
             t0 = self.clock()
             self._key, sub = jax.random.split(self._key)
             toks, logits = self.adapter.decode(
-                [r.rid if r is not None else None for r in self._slots],
+                slot_rids,
                 self._lens,
                 self._tokens,
                 sub,
@@ -680,7 +795,29 @@ class ServingEngine:
             ),
             "handoff_bytes": float(self._handoff_bytes),
             "handoff_s": float(self._handoff_wall),
+            # v14: speculative serving + chunked prefill + the paged
+            # attention kernel generation actually engaged (0 =
+            # reference gather, 1 = single-page kernel v1 path, 2 =
+            # kernel v2 — multi-page DMA and/or native quantized reads)
+            "spec_accept_rate": (
+                self._spec_accept_total / self._spec_draft_total
+                if self._spec_draft_total
+                else 0.0
+            ),
+            "spec_draft_tokens": float(self.adapter.spec_draft_tokens),
+            "prefill_chunks": float(self._prefill_chunks),
+            "paged_kernel_impl": float(self._paged_kernel_impl()),
         }
+
+    def _paged_kernel_impl(self) -> int:
+        if self.attn_impl != "kernel":
+            return 0
+        if self.serve_cfg.kv_quant != "none" or (
+            self.block_kv and self.page_size
+            and self.block_kv != self.page_size
+        ):
+            return 2
+        return 1
 
 
 def _role_code(role: str) -> int:

@@ -224,6 +224,16 @@ class FamilyAdapter:
     mesh = None  # the serving mesh when serve_layout is set, else None
     supports_handoff: bool = False
     supports_layout: bool = False
+    # speculative serving (ServeConfig.speculator_path): the adapter
+    # flips ``speculative`` when it loaded a draft head; the engine then
+    # routes through ``decode_spec`` and budgets ``spec_draft_tokens``
+    # extra cache positions per stream for in-flight draft writes
+    speculative: bool = False
+    spec_draft_tokens: int = 0
+    # chunked prefill (ServeConfig.prefill_chunk_tokens): families that
+    # can advance a prompt in slices through prefill_start/prefill_chunk
+    # set this; the engine rejects the knob for the rest at build
+    supports_chunked_prefill: bool = False
 
     def admission_error(self, prompt_len: int, max_new: int) -> Optional[str]:
         raise NotImplementedError
@@ -241,6 +251,31 @@ class FamilyAdapter:
         raise NotImplementedError
 
     def decode(self, slot_rids, lens, tokens, key):
+        raise NotImplementedError
+
+    # -- speculative decode (ServeConfig.speculator_path) ------------------
+
+    def decode_spec(self, slot_rids, lens, tokens):
+        """One draft-then-verify step over all slots: propose
+        ``spec_draft_tokens`` tokens per row, score them in one jitted
+        verify forward, commit the longest greedy-matching prefix.
+        Returns (emit (B, n+1) np.int32, counts (B,) np.int32, logits
+        (B, V) of each row's committed position) — row b's new tokens
+        are ``emit[b, :counts[b]]``."""
+        raise NotImplementedError
+
+    # -- chunked prefill (ServeConfig.prefill_chunk_tokens) ----------------
+
+    def prefill_start(self, rid: int, slot: int, prompt) -> None:
+        """Allocate the stream's state and stage ``prompt`` for
+        incremental prefill; no forward runs yet."""
+        raise NotImplementedError
+
+    def prefill_chunk(self, rid: int):
+        """Advance a staged prefill by one chunk. Returns None while
+        incomplete; on the final chunk, commits the state and returns
+        the (V,) logits row of the last real prompt position —
+        bit-identical to what whole-prompt ``prefill`` returns."""
         raise NotImplementedError
 
     # -- serving layout (ServeConfig.serve_layout) -------------------------

@@ -15,8 +15,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from fms_fsdp_tpu.models.generation import prefill, sample_token
-from fms_fsdp_tpu.serve.decode import paged_decode_step
+from fms_fsdp_tpu.models.generation import decode_chunk, prefill, sample_token
+from fms_fsdp_tpu.models.speculative import speculator_propose
+from fms_fsdp_tpu.serve.decode import paged_decode_step, paged_verify_step
 from fms_fsdp_tpu.serve.families import FamilyAdapter
 from fms_fsdp_tpu.serve.kv_cache import RESERVED_PAGES, PagedKVCache
 
@@ -25,6 +26,7 @@ class LlamaAdapter(FamilyAdapter):
     family = "llama"
     supports_handoff = True
     supports_layout = True
+    supports_chunked_prefill = True
 
     def __init__(self, params, model_cfg, scfg, compute_dtype=None):
         from fms_fsdp_tpu.serve.engine import _DTYPES
@@ -80,13 +82,14 @@ class LlamaAdapter(FamilyAdapter):
         impl = scfg.attn_impl
         if impl == "auto":
             impl = "reference" if jax.default_backend() != "tpu" else "kernel"
-        if scfg.kv_quant != "none" and impl == "kernel":
-            impl = "reference"  # v1 kernel reads full-width pools
+        # v2 kernel reads quantized pools natively (in-VMEM dequantize
+        # from the scale pools) — no reference fallback on the TPU path
         self.attn_impl = impl
 
         self._prefill_cache: Dict = {}
         self._table_key = None
         self._table_dev = None
+        self._chunk_state: Dict = {}  # rid -> staged incremental prefill
 
         cfg = model_cfg
 
@@ -102,6 +105,7 @@ class LlamaAdapter(FamilyAdapter):
                 compute_dtype=self.compute_dtype,
                 quant=scfg.kv_quant,
                 attn_impl=impl,
+                block_kv=self.block_kv,
             )
             tok = sample_token(
                 logits, key, scfg.temperature, scfg.top_k, scfg.do_sample
@@ -112,13 +116,143 @@ class LlamaAdapter(FamilyAdapter):
         # pool copy per token
         self._decode_fn = jax.jit(_step, donate_argnums=(1,))
 
+        if scfg.speculator_path:
+            self._init_speculative(scfg, cfg, impl)
+
+    # -- speculative serving (ServeConfig.speculator_path) -----------------
+
+    def _init_speculative(self, scfg, cfg, impl) -> None:
+        from fms_fsdp_tpu.models.speculator import load_speculator
+
+        if scfg.do_sample:
+            raise ValueError(
+                "speculative serving is greedy-only: the accept rule "
+                "compares drafts against the base model's argmax — set "
+                "do_sample=False or unset speculator_path"
+            )
+        if scfg.role != "unified":
+            raise ValueError(
+                f"speculative serving is unified-only (role="
+                f"{scfg.role!r}): the draft state (the last base "
+                f"hidden state) is not part of the page handoff"
+            )
+        spec_params, spec_cfg = load_speculator(scfg.speculator_path)
+        if (
+            spec_cfg.emb_dim != cfg.emb_dim
+            or spec_cfg.vocab_size != cfg.src_vocab_size
+        ):
+            raise ValueError(
+                f"speculator geometry (emb_dim={spec_cfg.emb_dim}, "
+                f"vocab={spec_cfg.vocab_size}) does not match the base "
+                f"model (emb_dim={cfg.emb_dim}, "
+                f"vocab={cfg.src_vocab_size})"
+            )
+        n = spec_cfg.n_predict
+        if scfg.spec_draft_tokens:
+            if scfg.spec_draft_tokens > spec_cfg.n_predict:
+                raise ValueError(
+                    f"spec_draft_tokens={scfg.spec_draft_tokens} "
+                    f"exceeds the checkpoint's n_predict="
+                    f"{spec_cfg.n_predict}"
+                )
+            n = scfg.spec_draft_tokens
+        self.speculative = True
+        self.spec_draft_tokens = n
+        self._spec_params = spec_params
+        self._spec_cfg = spec_cfg
+        # the draft chain's input: each slot's last base hidden state
+        # (the embed that produced the slot's pending token); prefill
+        # and decode_spec keep it current, in compute dtype so the jit
+        # never retraces on a dtype flip
+        self._spec_embed = np.zeros(
+            (scfg.max_batch, cfg.emb_dim), np.dtype(self.compute_dtype)
+        )
+
+        def _spec_step(
+            params, spec_params, pools, page_table, seq_lens, tokens, embed
+        ):
+            # propose with the FULL checkpoint config (the variance-
+            # preserving state/emb weights depend on n_predict), then
+            # slice: each head only feeds on the previous ones, so a
+            # truncated chain equals the full chain's prefix
+            props = speculator_propose(
+                spec_params, embed, tokens, spec_cfg
+            )[:, :n]
+            b = tokens.shape[0]
+            cand = jnp.concatenate([tokens[:, None], props], axis=1)
+            logits, embeds, pools = paged_verify_step(
+                params,
+                pools,
+                page_table,
+                seq_lens,
+                cand,
+                cfg,
+                page_size=self.page_size,
+                compute_dtype=self.compute_dtype,
+                quant=scfg.kv_quant,
+                attn_impl=impl,
+            )
+            base_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            match = jnp.cumprod(
+                (props == base_next[:, :-1]).astype(jnp.int32), axis=1
+            )
+            k = match.sum(axis=1)  # (B,) accepted drafts, 0..n
+            rows = jnp.arange(b)
+            bonus = base_next[rows, k]  # the base's own pick at the
+            # first mismatch (or the position after a full accept)
+            prop_pad = jnp.concatenate(
+                [props, jnp.zeros((b, 1), jnp.int32)], axis=1
+            )
+            emit = jnp.where(
+                jnp.arange(n + 1)[None, :] == k[:, None],
+                bonus[:, None],
+                prop_pad,
+            )
+            return (
+                emit,
+                (k + 1).astype(jnp.int32),
+                logits[rows, k],
+                embeds[rows, k],
+                pools,
+            )
+
+        self._spec_fn = jax.jit(_spec_step, donate_argnums=(2,))
+
+    def decode_spec(self, slot_rids, lens, tokens):
+        tkey = (self.cache.table_version, tuple(slot_rids))
+        if tkey != self._table_key:
+            self._table_key = tkey
+            self._table_dev = self._dev(
+                self.cache.page_table(list(slot_rids), self.max_pages)
+            )
+        emit, counts, logits, embeds, pools = self._spec_fn(
+            self.params,
+            self._spec_params,
+            self.cache.pools,
+            self._table_dev,
+            self._dev(lens),
+            self._dev(tokens),
+            self._dev(self._spec_embed),
+        )
+        self.cache.pools = pools
+        # np.array (not asarray): prefill writes rows in place when a
+        # new stream lands in a slot, so the host copy must be writable
+        self._spec_embed = np.array(embeds)
+        return np.asarray(emit), np.asarray(counts), logits
+
     # -- capacity ----------------------------------------------------------
 
     def _padded(self, n: int) -> int:
         return self._padded_len(n, self.scfg.prefill_bucket)
 
     def admission_error(self, prompt_len: int, max_new: int) -> Optional[str]:
-        worst = self._padded(prompt_len + max_new - 1) + 1
+        # speculative verify writes draft tokens past the committed
+        # length before rollback — budget those cache positions too
+        worst = (
+            self._padded(prompt_len + max_new - 1)
+            + 1
+            + self.spec_draft_tokens
+        )
         need = self.cache.pages_needed(worst)
         total = self.cache.num_pages - RESERVED_PAGES
         if need > total:
@@ -136,6 +270,7 @@ class LlamaAdapter(FamilyAdapter):
         return self.cache.ensure(rid, n_tokens)
 
     def release(self, rid: int, slot: int) -> None:
+        self._chunk_state.pop(rid, None)
         self.cache.free(rid)
 
     # -- prefill -----------------------------------------------------------
@@ -165,15 +300,110 @@ class LlamaAdapter(FamilyAdapter):
         toks = np.zeros((1, p_pad), np.int32)
         toks[0, :p] = prompt
         full_logits = p_pad != p
-        logits, _, kv = self._get_prefill(p_pad, s_pad, full_logits)(
+        logits, embeds, kv = self._get_prefill(p_pad, s_pad, full_logits)(
             self.params, self._dev(toks)
         )
         self.cache.write_prompt(rid, kv["k"][:, 0], kv["v"][:, 0])
+        if self.speculative:
+            # seed the draft chain with the hidden state that produced
+            # this stream's first token
+            self._spec_embed[slot] = np.asarray(embeds[0, p - 1])
         # logits of the last REAL position predict the next token
         row = logits[0, p - 1] if full_logits else logits[0, 0]
         # on a mesh, hand the engine a host row: the engine's eager
         # sampler mixes it with its single-device rng key, which jax
         # refuses across device sets
+        return np.asarray(row) if self.mesh is not None else row
+
+    # -- chunked prefill (ServeConfig.prefill_chunk_tokens) ----------------
+
+    def _get_chunk_fn(self, m: int, s_pad: int):
+        key = ("chunk", m, s_pad)
+        fn = self._prefill_cache.get(key)
+        if fn is None:
+            fn = jax.jit(
+                partial(
+                    decode_chunk,
+                    cfg=self.model_cfg,
+                    compute_dtype=self.compute_dtype,
+                ),
+                donate_argnums=(1,),
+            )
+            self._prefill_cache[key] = fn
+        return fn
+
+    def prefill_start(self, rid: int, slot: int, prompt) -> None:
+        """Stage ``prompt`` for incremental prefill: allocate the full
+        page budget up front (so admission capacity stays honest), then
+        advance through a zero-initialized dense mini-cache one chunk
+        per ``prefill_chunk``. decode_chunk runs the same attention
+        einsum as whole-prompt ``prefill`` over the same zeroed cache,
+        so the chunked logits — and the k/v written to pages at the
+        end — are bit-identical to the whole-prompt path."""
+        p = len(prompt)
+        p_pad = self._padded(p)
+        s_pad = self.cache.pages_needed(p_pad) * self.page_size
+        ok = self.cache.ensure(rid, p_pad)
+        assert ok, "admission checked capacity; ensure cannot fail here"
+        toks = np.zeros((1, p_pad), np.int32)
+        toks[0, :p] = prompt
+        nlayers = int(self.params["layers"]["wq"].shape[0])
+        # mini-cache length p_pad, NOT s_pad: whole-prompt prefill's
+        # attention reduces over exactly p_pad key positions, and
+        # matching that reduction length is what keeps the chunked
+        # logits bit-identical; the page-granular tail is padded with
+        # zeros only at the final write (same bytes the whole path's
+        # zero-initialized cache tail carries)
+        shape = (
+            nlayers,
+            1,
+            p_pad,
+            self.model_cfg.n_kv_heads,
+            self.model_cfg.head_dim,
+        )
+        self._chunk_state[rid] = {
+            "slot": slot,
+            "toks": toks,
+            "p": p,
+            "p_pad": p_pad,
+            "s_pad": s_pad,
+            "pos": 0,
+            "cache": {
+                "k": jnp.zeros(shape, self.compute_dtype),
+                "v": jnp.zeros(shape, self.compute_dtype),
+            },
+            "row": None,
+            "embed": None,
+        }
+
+    def prefill_chunk(self, rid: int):
+        st = self._chunk_state[rid]
+        pos = st["pos"]
+        m = min(self.scfg.prefill_chunk_tokens, st["p_pad"] - pos)
+        logits, embeds, st["cache"] = self._get_chunk_fn(m, st["p_pad"])(
+            self.params,
+            st["cache"],
+            self._dev(st["toks"][:, pos : pos + m]),
+            pos,
+        )
+        last = st["p"] - 1
+        if pos <= last < pos + m:
+            # the chunk holding the last REAL prompt position carries
+            # the first token's logits (padding chunks past it only
+            # complete the bucketed cache write)
+            st["row"] = logits[0, last - pos]
+            st["embed"] = embeds[0, last - pos]
+        st["pos"] = pos + m
+        if st["pos"] < st["p_pad"]:
+            return None
+        tail = st["s_pad"] - st["p_pad"]
+        pad = ((0, 0), (0, 0), (0, tail), (0, 0), (0, 0))
+        cache = {n: jnp.pad(a, pad) for n, a in st["cache"].items()}
+        self.cache.write_prompt(rid, cache["k"][:, 0], cache["v"][:, 0])
+        if self.speculative:
+            self._spec_embed[st["slot"]] = np.asarray(st["embed"])
+        row = st["row"]
+        del self._chunk_state[rid]
         return np.asarray(row) if self.mesh is not None else row
 
     # -- decode ------------------------------------------------------------

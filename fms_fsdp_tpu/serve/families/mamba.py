@@ -71,6 +71,14 @@ class MambaAdapter(FamilyAdapter):
                 "mamba serving stores its recurrent slab unquantized and "
                 "hybrid attn pages full-width: set kv_quant='none'"
             )
+        if getattr(scfg, "speculator_path", ""):
+            raise ValueError(
+                "mamba serving has no speculative decode path yet: the "
+                "MLPSpeculator draft/verify loop is llama-only (the "
+                "verify step replays positions through paged KV, which "
+                "the recurrent slab cannot roll back) — unset "
+                "speculator_path"
+            )
         self.attn_impl = "reference" if self._hybrid else "none"
 
         if self._hybrid:

@@ -64,6 +64,13 @@ class MixtralAdapter(FamilyAdapter):
                 "mixtral serving stores attn pages full-width in v1: "
                 "set kv_quant='none'"
             )
+        if getattr(scfg, "speculator_path", ""):
+            raise ValueError(
+                "mixtral serving has no speculative decode path yet: "
+                "the MLPSpeculator draft/verify loop is llama-only (the "
+                "verify forward has no expert-routed chunk step) — "
+                "unset speculator_path"
+            )
         self.attn_impl = "reference"
         # serve_layout: mesh + sharded params (attention follows the
         # llama megatron layout; expert weights keep their fsdp/tensor

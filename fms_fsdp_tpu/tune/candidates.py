@@ -349,6 +349,7 @@ PAGED_DEFAULT_PAGE_SIZE = 64
 PAGED_DEFAULT_BLOCK_KV = 64
 
 _PAGE_SIZE_CHOICES = (16, 32, 64, 128, 256)
+_BLOCK_KV_MULTIPLES = (1, 2, 4)
 
 
 def paged_decode_sig(batch: int, nq: int, nkv: int, head: int,
@@ -385,20 +386,26 @@ def paged_decode_vmem_bytes(sig: Dict[str, int], dtype: str,
 
 def paged_decode_candidates(sig: Dict[str, int], dtype: str,
                             chip: str) -> List[Dict]:
-    """Legal (page_size, block_kv) tiles under the VMEM budget. The v1
-    kernel walks one page per grid step, so enumeration keeps
-    block_kv == page_size; the cost model prices larger multi-page
-    blocks too (manual-DMA fetch, the RPA paper's layout) so a future
-    kernel can consume measured entries without a schema change."""
+    """Legal (page_size, block_kv) tiles under the VMEM budget. The v2
+    kernel walks ``block_kv // page_size`` pool pages per grid step
+    (manual-DMA fetch, the RPA paper's layout), so enumeration covers
+    block_kv multiples of page_size — more positions per cell amortize
+    the per-step grid overhead at the price of a wider VMEM block."""
     budget = vmem_budget(chip)
     out = []
     for ps in _PAGE_SIZE_CHOICES:
         if ps > sig["max_seq"] or sig["max_seq"] % ps != 0:
             continue
-        vmem = paged_decode_vmem_bytes(sig, dtype, ps, ps)
-        if vmem > budget:
-            continue
-        out.append({"page_size": ps, "block_kv": ps, "vmem_bytes": vmem})
+        for mult in _BLOCK_KV_MULTIPLES:
+            bkv = ps * mult
+            if bkv > sig["max_seq"]:
+                continue
+            vmem = paged_decode_vmem_bytes(sig, dtype, ps, bkv)
+            if vmem > budget:
+                continue
+            out.append(
+                {"page_size": ps, "block_kv": bkv, "vmem_bytes": vmem}
+            )
     return out
 
 

@@ -155,6 +155,17 @@ def _cost_model_pick(kernel: str, sig: dict, cands: list, dtype: str,
         best = min(cands, key=lambda c: c.get("cost_us", float("inf")))
         return _strip(best)
     default = _default_config(kernel)
+    if kernel == "paged_decode":
+        # keep the measured-in-anger page size, but take the widest
+        # legal block_kv at it: the v2 kernel fetches block_kv//page_size
+        # pages per grid step, and more positions per cell amortize the
+        # per-step overhead (tie-break the cost model can price blind)
+        at_ps = [c for c in cands
+                 if c.get("page_size") == default["page_size"]]
+        if at_ps:
+            best = max(at_ps, key=lambda c: c["block_kv"])
+            return _strip(best)
+        return default
     for c in cands:
         if all(c.get(k) == v for k, v in default.items() if k != "family"):
             d = dict(default)
@@ -259,8 +270,11 @@ def _measure_child(spec_json: str):
         table = np.arange(2, 2 + b * maxp, dtype=np.int32).reshape(b, maxp)
         lens = np.full((b,), (3 * max_seq) // 4, np.int32)
 
+        bkv = int(config.get("block_kv", ps))
         f = jax.jit(
-            lambda q, kp, vp, t, l: paged_attention_kernel(q, kp, vp, t, l)
+            lambda q, kp, vp, t, l: paged_attention_kernel(
+                q, kp, vp, t, l, block_kv=bkv
+            )
         )
         args = (q, kp, vp, jnp.asarray(table), jnp.asarray(lens))
     elif kernel == "dcn_bucket":

@@ -36,6 +36,25 @@ PR 18 rows (every row now carries ``serve_layout``, "" = single-chip):
   disagg row carries the handoff ledger (``requests_handed_off``,
   ``handoff_bytes``, ``prefill_replicas``).
 
+PR 19 rows (every row now carries ``spec_accept_rate`` /
+``spec_draft_tokens`` / ``prefill_chunks`` / ``paged_kernel_impl``):
+
+- ``speculative``: the llama steady-state wave with a bench-distilled
+  MLPSpeculator (train_bench_speculator — fit on the base model's own
+  greedy continuations so the row measures real acceptance, not a
+  random head's ~0). Greedy accept keeps the emitted stream
+  token-identical to the plain llama row; ``spec_accept_rate`` is the
+  fraction of drafted tokens the base kept;
+- ``kernel-v2-int8``: the int8-paged wave decoded through the v2
+  paged-attention kernel (multi-page DMA, native quantized page reads
+  with in-kernel dequantize — ``paged_kernel_impl: 2``); interpret-mode
+  on a TPU-less host;
+- ``long-prompt-whole`` / ``long-prompt-chunked``: the same mixed wave
+  (long interferer prompts ahead of short requests) on one engine,
+  whole-prompt prefill vs ``prefill_chunk_tokens=16``. TTFT covers the
+  shorts only — the pair quantifies what decode-interleaved chunked
+  prefill buys p99 TTFT on a single replica.
+
 Fallback-tier contract (bench.py's): the engine measures on whatever
 backend answers — on a TPU-less host the numbers are CPU-relative but
 MEASURED, so the record carries ``degraded: false`` with
@@ -100,6 +119,18 @@ _ROW_REQUIRED = {
     # sharding — ServeConfig.serve_layout); fleet rows report the
     # layout their replicas ran
     "serve_layout": str,
+    # PR 19 raw-speed fields, on EVERY row so the speculative /
+    # chunked / kernel-v2 rows and the steady-state rows share one
+    # schema: accepted-draft fraction (0.0 = speculation off or
+    # nothing accepted), draft tokens per verify step (0 =
+    # non-speculative), chunked-prefill slices advanced (0 =
+    # whole-prompt), and the paged-attention kernel generation engaged
+    # (0 = reference gather, 1 = kernel v1 single-page, 2 = kernel v2
+    # multi-page / quantized-native)
+    "spec_accept_rate": (int, float),
+    "spec_draft_tokens": int,
+    "prefill_chunks": int,
+    "paged_kernel_impl": int,
 }
 
 
@@ -157,42 +188,64 @@ def _zero_doc():
 
 
 def run_row(params, cfg, max_batch, n_requests, prompt_len, max_new,
-            kv_quant="none", serve_layout=""):
+            kv_quant="none", serve_layout="", mode="", attn_impl="auto",
+            speculator_path="", prefill_chunk_tokens=0, wave=None,
+            ttft_idx=None, seq=0):
+    """One engine row. ``wave`` overrides the uniform random wave
+    ([(prompt, max_new), ...] — the long-prompt pair's mixed shape);
+    ``ttft_idx`` narrows the TTFT percentiles to a sub-wave; ``seq``
+    overrides the engine's max_seq_len (the long-prompt pair's larger
+    context)."""
     import numpy as np
 
     from fms_fsdp_tpu.serve import ServeConfig, ServingEngine
 
     scfg = ServeConfig(
         max_batch=max_batch,
-        max_seq_len=SEQ,
+        max_seq_len=seq or SEQ,
         kv_quant=kv_quant,
         serve_layout=serve_layout,
+        attn_impl=attn_impl,
+        speculator_path=speculator_path,
+        prefill_chunk_tokens=prefill_chunk_tokens,
     )
     eng = ServingEngine(params, cfg, scfg)
-    rng = np.random.default_rng(0)
-    vocab = getattr(cfg, "src_vocab_size", None) or cfg.vocab_size
-    prompts = rng.integers(0, vocab, size=(n_requests, prompt_len))
+    if wave is None:
+        rng = np.random.default_rng(0)
+        vocab = getattr(cfg, "src_vocab_size", None) or cfg.vocab_size
+        wave = [
+            (p.tolist(), max_new)
+            for p in rng.integers(0, vocab, size=(n_requests, prompt_len))
+        ]
     # warmup wave: compiles prefill + decode; the wall/token accounting
     # is reset after so compile time never pollutes the measured rate
-    for p in prompts:
-        eng.submit(p.tolist(), max_new)
+    for p, n in wave:
+        eng.submit(p, n)
     eng.run()
     eng._decode_tokens = 0
     eng._decode_wall = 0.0
-    reqs = [eng.submit(p.tolist(), max_new) for p in prompts]
+    eng._spec_draft_total = 0
+    eng._spec_accept_total = 0
+    eng._prefill_chunks = 0
+    reqs = [eng.submit(p, n) for p, n in wave]
     pages_peak = 0
     while eng.has_work():
         eng.step()
         pages_peak = max(pages_peak, eng.adapter.pages_in_use)
-    ttfts = [r.ttft for r in reqs if r.ttft is not None]
+    ttfts = [
+        r.ttft
+        for r in ([reqs[i] for i in ttft_idx] if ttft_idx else reqs)
+        if r.ttft is not None
+    ]
     lats = [r.latency for r in reqs if r.latency is not None]
     tok_s = (
         eng._decode_tokens / eng._decode_wall if eng._decode_wall else 0.0
     )
-    return {
+    st = eng.serving_stats()
+    row = {
         "family": eng.family,
         "max_batch": max_batch,
-        "requests": n_requests,
+        "requests": len(wave),
         "prompt_len": prompt_len,
         "max_new_tokens": max_new,
         "page_size": eng.page_size,
@@ -217,7 +270,158 @@ def run_row(params, cfg, max_batch, n_requests, prompt_len, max_new,
             sum(r.state == "finished" for r in reqs) / max(1, len(reqs)),
             4,
         ),
+        # PR 19 raw-speed fields (measured wave; serving_stats v14)
+        "spec_accept_rate": round(float(st["spec_accept_rate"]), 4),
+        "spec_draft_tokens": int(st["spec_draft_tokens"]),
+        "prefill_chunks": int(st["prefill_chunks"]),
+        "paged_kernel_impl": int(st["paged_kernel_impl"]),
     }
+    if mode:
+        row["mode"] = mode
+    return row
+
+
+def train_bench_speculator(params, cfg, path, n_predict=3, steps=400):
+    """Mini-distill an MLPSpeculator onto the base model's own greedy
+    continuations of the bench wave (seconds on CPU), so the speculative
+    row measures a real acceptance rate — a random-init head accepts
+    ~0 drafts and would bench the overhead, not the feature. The
+    serving engine only guarantees parity, never quality, so the bench
+    must bring a speculator that actually speculates."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fms_fsdp_tpu.models.generation import decode_step, prefill
+    from fms_fsdp_tpu.models.speculator import (
+        SpeculatorConfig,
+        init_speculator_params,
+        save_speculator,
+        speculator_forward,
+    )
+
+    rng = np.random.default_rng(0)
+    vocab = cfg.src_vocab_size
+    toks = jnp.asarray(
+        rng.integers(0, vocab, size=(REQUESTS, PROMPT)), jnp.int32
+    )
+    # teacher trace: greedy-decode the exact bench wave, keeping every
+    # position's hidden state (bfloat16 — the serving compute dtype, so
+    # the distilled chain sees the embeddings it will see in the engine)
+    logits, embeds, cache = jax.jit(
+        functools.partial(prefill, cfg=cfg, max_seq_len=SEQ,
+                          full_logits=True)
+    )(params, toks)
+    step = jax.jit(functools.partial(decode_step, cfg=cfg))
+    all_toks, all_embeds = [toks], [embeds]
+    tok = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+    for pos in range(PROMPT, PROMPT + NEW):
+        all_toks.append(tok[:, None])
+        lg, em, cache = step(params, cache, tok[:, None], pos)
+        all_embeds.append(em[:, None])
+        tok = jnp.argmax(lg, -1).astype(jnp.int32)
+    T = jnp.concatenate(all_toks, 1)  # (B, P+NEW)
+    E = jnp.concatenate(all_embeds, 1).astype(jnp.float32)
+
+    # teacher-forced chain loss: window t's state is the embed that
+    # predicted token t+1, head i feeds token t+1+i and targets t+2+i —
+    # exactly speculator_propose's inference alignment
+    n = n_predict
+    n_win = T.shape[1] - n - 1
+    state, inds = E[:, :n_win], T[:, 1 : n + n_win]
+    targets = jnp.stack(
+        [T[:, 2 + i : 2 + i + n_win] for i in range(n)], 0
+    )  # (n, B, N)
+
+    scfg = SpeculatorConfig(
+        emb_dim=cfg.emb_dim, inner_dim=cfg.emb_dim, vocab_size=vocab,
+        n_predict=n,
+    )
+    sp = init_speculator_params(jax.random.PRNGKey(1), scfg)
+
+    def loss_fn(p):
+        lp = jax.nn.log_softmax(
+            speculator_forward(p, state, inds, scfg).astype(jnp.float32)
+        )
+        return -jnp.take_along_axis(lp, targets[..., None], -1).mean()
+
+    # inline Adam (the container ships no optimizer lib; 20 lines beats
+    # a dependency for a 400-step fit)
+    m = jax.tree.map(jnp.zeros_like, sp)
+    v = jax.tree.map(jnp.zeros_like, sp)
+
+    @jax.jit
+    def update(p, m, v, t):
+        g = jax.grad(loss_fn)(p)
+        m = jax.tree.map(lambda a, b: 0.9 * a + 0.1 * b, m, g)
+        v = jax.tree.map(lambda a, b: 0.999 * a + 0.001 * b * b, v, g)
+        p = jax.tree.map(
+            lambda a, mm, vv: a - 2e-3
+            * (mm / (1 - 0.9**t))
+            / (jnp.sqrt(vv / (1 - 0.999**t)) + 1e-8),
+            p, m, v,
+        )
+        return p, m, v
+
+    for t in range(1, steps + 1):
+        sp, m, v = update(sp, m, v, t)
+    save_speculator(path, sp, scfg)
+    return path
+
+
+def run_longprompt_rows(params, cfg):
+    """``long-prompt-whole`` vs ``long-prompt-chunked``: the same mixed
+    wave — long-prompt interferers submitted ahead of short requests —
+    on ONE engine, whole-prompt prefill vs ``prefill_chunk_tokens``.
+    Both rows' ``ttft_s`` covers the SHORT requests only: the pair is
+    the measured answer to "what does slicing interferer prefill into
+    decode-interleaved chunks buy p99 TTFT" (the single-replica twin of
+    the fleet-unified/fleet-disagg pair).
+
+    The pair runs a 4x-SEQ context (head-of-line blocking only shows up
+    when one whole-prompt prefill costs many decode steps of wall, and
+    the prefill's attention term is quadratic in prompt length — at the
+    steady-state rows' scale the effect drowns in per-step dispatch
+    overhead) and a batch wide enough to seat the whole wave: with
+    starved slots, chunking's longer slot-hold on the interferers
+    delays the LAST shorts' admission and muddies the p99 it exists to
+    cut — the pair isolates prefill head-of-line blocking, not slot
+    capacity (the oversubscribed row owns that axis)."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    vocab = cfg.src_vocab_size
+    seq = 4 * SEQ
+    cfg = _dc.replace(cfg, max_expected_seq_len=seq)  # params are
+    # shape-independent of the rope horizon, so the steady-state
+    # weights serve the longer context unchanged
+    long_len = min(3 * SEQ, seq - NEW - 1)
+    n_long = max(2, REQUESTS // 2)
+    wave, short_idx = [], []
+    for _ in range(n_long):
+        wave.append((rng.integers(0, vocab, size=long_len).tolist(), NEW))
+    for _ in range(REQUESTS):
+        short_idx.append(len(wave))
+        wave.append((rng.integers(0, vocab, size=8).tolist(), NEW))
+
+    rows = []
+    for mode, chunk in (
+        ("long-prompt-whole", 0),
+        ("long-prompt-chunked", 64),
+    ):
+        row = run_row(
+            params, cfg, len(wave), len(wave), 8, NEW, mode=mode,
+            prefill_chunk_tokens=chunk, wave=wave, ttft_idx=short_idx,
+            seq=seq,
+        )
+        row["interferer_prompt_len"] = long_len
+        row["interferers"] = len(wave) - len(short_idx)
+        rows.append(row)
+    return rows
 
 
 def _run_fleet(model_cfg_dict, wave, faults="", n_replicas=2, prefill=0,
@@ -305,6 +509,12 @@ def _fleet_row(mode, recs, stats, wall, ttft_recs=None):
         "kv_pages_peak": 0,
         "state_bytes_per_stream": 0,
         "availability": round(completed / max(1, len(recs)), 4),
+        # fleet replicas run non-speculative whole-prompt reference
+        # decode in this bench; zeros keep the one-schema contract
+        "spec_accept_rate": 0.0,
+        "spec_draft_tokens": 0,
+        "prefill_chunks": 0,
+        "paged_kernel_impl": 0,
         "replica_availability": round(stats["availability"], 6),
         "replicas": int(stats["replicas"]),
         "restarts": int(stats["restarts"]),
@@ -469,11 +679,34 @@ def main():
         for f in families
     ]
     if args.family == "all":
+        import tempfile
+
         cfg, p = cfgs["llama"], params["llama"]
+        spec_path = os.path.join(
+            tempfile.mkdtemp(prefix="bench_spec_"), "speculator.pkl"
+        )
+        train_bench_speculator(p, cfg, spec_path)
         rows += [
             # quantized page storage: the resident-KV-bytes lever
             run_row(p, cfg, BATCH, REQUESTS, PROMPT, NEW,
                     kv_quant="int8"),
+            # speculative serving: the bench-distilled MLPSpeculator
+            # drafts 3 tokens per verify step; the row's
+            # spec_accept_rate explains its tokens_per_sec (greedy
+            # accept — the emitted stream is token-identical to the
+            # non-speculative llama row above)
+            run_row(p, cfg, BATCH, REQUESTS, PROMPT, NEW,
+                    mode="speculative", speculator_path=spec_path),
+            # paged-attention kernel v2 on natively-quantized pages
+            # (paged_kernel_impl=2: multi-page DMA + in-kernel
+            # dequantize; interpret-mode on a TPU-less host, so the
+            # CPU number measures the path, not the silicon)
+            run_row(p, cfg, BATCH, REQUESTS, PROMPT, NEW,
+                    mode="kernel-v2-int8", kv_quant="int8",
+                    attn_impl="kernel"),
+            # whole vs chunked prefill under long-prompt interferers:
+            # the single-replica p99-TTFT pair
+            *run_longprompt_rows(p, cfg),
             # oversubscribed: 2x the requests on the same batch — queue
             # wait lands in TTFT, the continuous-batching stress shape
             run_row(p, cfg, BATCH, 2 * REQUESTS, PROMPT, NEW),

@@ -33,6 +33,17 @@ MEASURED < 1.0 (the churn happened) while per-request completion stays
 stall via the watchdog. The fleet stats map is validated against the
 obs schema ``serving_fleet`` field (v13).
 
+``--speculative`` reruns the kill/stall schedule on a SPECULATIVE
+llama fleet (every replica drafts through a random-init MLPSpeculator
+checkpoint written into the workdir) and asserts its tokens against
+the PLAIN fleet's reference run: greedy speculative decode must be
+token-identical to non-speculative greedy — including requeued
+requests whose recompute-on-resume re-prefills and re-drafts from
+scratch on the surviving replica. A random head keeps the accept rate
+near zero, which is the point: every draft still flows through the
+verify/accept path, so parity is pinned on the mechanism, not on a
+lucky always-accept stream.
+
 ``--disagg`` swaps the schedule for a disaggregated fleet (1 prefill +
 2 decode replicas, ``FleetConfig.prefill_replicas``): the same wave
 runs against the unified reference, then twice faulted — the prefill
@@ -136,16 +147,22 @@ def make_wave(n, seed):
     return wave
 
 
-def run_fleet(tag, workdir, faults="", n_replicas=2, prefill=0):
+def run_fleet(tag, workdir, faults="", n_replicas=2, prefill=0,
+              serve_cfg=None, stall_timeout=None):
     """One fleet run over the wave. Returns (tokens_by_rid, stats,
     ledger, wall_s). ``prefill`` > 0 turns the fleet disaggregated:
     replicas [0, prefill) run role=prefill, the rest role=decode, and
-    the router journals each KV-page handoff before forwarding."""
+    the router journals each KV-page handoff before forwarding.
+    ``serve_cfg`` overrides the shared SERVE_CFG (the --speculative
+    schedule's speculator_path); ``stall_timeout`` overrides the
+    per-family watchdog (the speculative verify step adds a jit
+    compile the 10s llama default would misread as a stall)."""
+    scfg = serve_cfg or SERVE_CFG
     wdir = os.path.join(workdir, tag)
     spawn = make_subprocess_spawn(
         wdir,
         MODEL_CFG,
-        SERVE_CFG,
+        scfg,
         init_seed=SEED,
         faults=faults,
         env_extra={"JAX_PLATFORMS": "cpu"},
@@ -154,11 +171,11 @@ def run_fleet(tag, workdir, faults="", n_replicas=2, prefill=0):
     cfg = FleetConfig(
         n_replicas=n_replicas,
         prefill_replicas=prefill,
-        max_seq_len=SERVE_CFG["max_seq_len"],
+        max_seq_len=scfg["max_seq_len"],
         max_inflight_per_replica=4,
         # above the worst single-step wall on CPU (a residual jit
         # compile), far below the injected 600s stall
-        stall_timeout_s=STALL_TIMEOUT_S[FAMILY],
+        stall_timeout_s=stall_timeout or STALL_TIMEOUT_S[FAMILY],
         startup_timeout_s=180.0,
         restart_backoff_s=0.2,
         journal_path=os.path.join(wdir, "journal.jsonl"),
@@ -318,6 +335,101 @@ def run_disagg_soak(out):
           f"{dk_stats['availability']:.4f}")
 
 
+def _write_speculator(out):
+    """Random-init serving speculator checkpoint for the --speculative
+    schedule. The soak pins PARITY (speculative greedy == plain greedy
+    under churn), never speed — a random head keeps the accept rate
+    near zero while every draft still flows through the verify/accept
+    path, which is exactly the mechanism under test."""
+    import jax
+
+    from fms_fsdp_tpu.models.speculator import (
+        SpeculatorConfig,
+        init_speculator_params,
+        save_speculator,
+    )
+
+    scfg = SpeculatorConfig(
+        emb_dim=MODEL_CFG["emb_dim"],
+        inner_dim=32,
+        vocab_size=MODEL_CFG["src_vocab_size"],
+        n_predict=3,
+    )
+    path = os.path.join(out, "speculator.pkl")
+    save_speculator(
+        path, init_speculator_params(jax.random.PRNGKey(7), scfg), scfg
+    )
+    return path
+
+
+def run_speculative_soak(out):
+    """--speculative: the kill/stall schedule on a speculative llama
+    fleet, token-parity-checked against the PLAIN fleet's reference
+    run. Three runs:
+
+    1. **reference**: the unfaulted NON-speculative fleet — the greedy
+       baseline every later run must reproduce;
+    2. **spec_reference**: the unfaulted speculative fleet — isolates
+       the draft/verify/accept parity claim from churn;
+    3. **kill** / **stall**: the faulted speculative fleet — a requeued
+       request's recompute-on-resume re-prefills (re-stashing the draft
+       embedding) and re-drafts on the survivor, and must still emit
+       the plain fleet's exact tokens.
+    """
+    ref_tokens, ref_stats, _, _ = run_fleet("reference", out)
+    assert ref_stats["restarts"] == 0, "reference run must be unfaulted"
+
+    spec_cfg = dict(SERVE_CFG, speculator_path=_write_speculator(out))
+    spec_tokens, spec_stats, _, _ = run_fleet(
+        "spec_reference", out, serve_cfg=spec_cfg, stall_timeout=30.0
+    )
+    assert spec_stats["restarts"] == 0, "spec reference must be unfaulted"
+    for rid, toks in ref_tokens.items():
+        assert spec_tokens[rid] == toks, (
+            f"[spec_reference] rid {rid} speculative greedy diverged "
+            f"from plain greedy:\n  ref: {toks}\n  got: {spec_tokens[rid]}"
+        )
+
+    kill_tokens, kill_stats, kill_ledger, _ = run_fleet(
+        "spec_kill", out,
+        faults="replica_kill:replica=1:step=10:times=1",
+        serve_cfg=spec_cfg, stall_timeout=30.0,
+    )
+    assert_faulted("spec_kill", ref_tokens, kill_tokens, kill_stats,
+                   kill_ledger)
+
+    stall_tokens, stall_stats, stall_ledger, _ = run_fleet(
+        "spec_stall", out,
+        faults="replica_stall:replica=0:step=10:seconds=600:times=1",
+        serve_cfg=spec_cfg, stall_timeout=30.0,
+    )
+    assert_faulted("spec_stall", ref_tokens, stall_tokens, stall_stats,
+                   stall_ledger)
+    assert stall_stats["stalls_detected"] >= 1, (
+        "watchdog never fired on the stalled replica"
+    )
+
+    validate_obs_map(kill_stats)
+
+    summary = {
+        "family": FAMILY,
+        "mode": "speculative",
+        "requests": N_REQUESTS,
+        "reference": ref_stats,
+        "spec_reference": spec_stats,
+        "kill": kill_stats,
+        "stall": stall_stats,
+        "zero_drops": True,
+        "token_parity": True,
+    }
+    with open(os.path.join(out, "fleet_soak_speculative.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print("speculative chaos soak PASSED: zero drops, speculative "
+          "greedy token parity vs plain fleet, kill availability "
+          f"{kill_stats['availability']:.4f}, stall availability "
+          f"{stall_stats['availability']:.4f}")
+
+
 def main():
     global MODEL_CFG, FAMILY
     ap = argparse.ArgumentParser(description=__doc__)
@@ -332,17 +444,33 @@ def main():
                          "decode replicas, journaled KV-page handoff) "
                          "with kills on either side of the wire, "
                          "instead of the unified kill/stall schedule")
+    ap.add_argument("--speculative", action="store_true",
+                    help="soak a speculative llama fleet (random-init "
+                         "MLPSpeculator draft/verify on every replica) "
+                         "and assert greedy token parity against the "
+                         "plain fleet's reference run")
     args = ap.parse_args()
     MODEL_CFG = MODEL_CFGS[args.family]
     FAMILY = args.family
     if args.disagg and args.family != "llama":
         ap.error("--disagg requires --family llama (mamba's slab state "
                  "has no page handoff; its adapter is unified-only)")
+    if args.speculative and args.family != "llama":
+        ap.error("--speculative requires --family llama (the "
+                 "MLPSpeculator draft/verify loop is llama-only)")
+    if args.speculative and args.disagg:
+        ap.error("--speculative and --disagg are mutually exclusive: a "
+                 "speculative engine rejects handoff resumes (the draft "
+                 "embedding is not part of the page handoff)")
     out = args.out or tempfile.mkdtemp(prefix=f"fleet_soak_{FAMILY}_")
     os.makedirs(out, exist_ok=True)
     if args.disagg:
         print(f"disagg serving chaos soak ({FAMILY} fleet) -> {out}")
         run_disagg_soak(out)
+        return
+    if args.speculative:
+        print(f"speculative serving chaos soak ({FAMILY} fleet) -> {out}")
+        run_speculative_soak(out)
         return
     print(f"serving chaos soak ({FAMILY} fleet) -> {out}")
 
