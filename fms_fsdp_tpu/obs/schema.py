@@ -17,7 +17,7 @@ import json
 import numbers
 from typing import Any, Dict, List
 
-SCHEMA_VERSION = 14
+SCHEMA_VERSION = 15
 
 # name -> (type, required)
 SCHEMA_FIELDS = {
@@ -120,6 +120,9 @@ SCHEMA_FIELDS = {
     # 0 = single-chip; parallel/sharding.py::serve_layout_code),
     # ``handoff_bytes`` (cumulative PageHandoff wire bytes packed +
     # imported) and ``handoff_s`` (wall seconds packing/scattering).
+    # v15: the map gains ``drained`` (1.0 once the engine stopped
+    # admitting — a draining/preempted replica is visibly winding down
+    # in its last heartbeats' stats).
     # v14: the map gains the raw-speed fields (docs/observability.md
     # "v14"): ``spec_accept_rate`` (accepted draft tokens over offered
     # — 0.0 when speculative serving is off), ``spec_draft_tokens``
@@ -138,6 +141,13 @@ SCHEMA_FIELDS = {
     # expired / failed / requeued / rejected), duplicates_dropped
     # (exactly-once dedup hits), completion_rate, p99_latency_s under
     # churn. Absent (null) on training runs and single-engine serving.
+    # v15: the map gains the streaming-transport + drain counters
+    # (docs/observability.md "v15"): ``handoff_retries`` (transfers
+    # that needed >= 1 chunk retransmit), ``chunks_resent`` (total
+    # retransmitted chunks, router side), ``transfers_resumed``
+    # (transfers that continued past an interruption — journal-seeded
+    # resume or in-flight retransmit) and ``drain_migrations`` (live
+    # streams migrated off a preempted replica with zero recompute).
     "serving_fleet": ("map", False),
     # v6: self-healing supervisor accounting (docs/resilience.md
     # "Self-healing supervisor"). The relaunched run reads the
@@ -229,6 +239,12 @@ SCHEMA_DIGESTS = {
     # paged_kernel_impl (the kernel generation engaged); the field set
     # itself is unchanged
     14: "2f8909a62cde9d1cdfd1d4153c219e37d8f16b8011a7f3dca7feeb5ebb2a567a",
+    # v15: serving map gains drained (engine stopped admitting — the
+    # drain/preempt wind-down flag); serving_fleet map gains
+    # handoff_retries / chunks_resent / transfers_resumed /
+    # drain_migrations (streaming state-transfer transport +
+    # drain-and-migrate preemption); the field set itself is unchanged
+    15: "72f5816eded0eb4caa3a834f60eb0dc10db1a31772699bf81af6c0c40665b38a",
 }
 
 

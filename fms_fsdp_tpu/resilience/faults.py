@@ -96,6 +96,25 @@ corpus_kill     SamplingDataset document boundaries and re-probe
                 ``corpus_loss``. Filtered by ``corpus`` (substring, so
                 one clause can kill a corpus family); ``times=N`` lets
                 the survivor-epoch re-probe heal it after N matches
+handoff_chunk_corrupt
+                ChunkSender.pump (serve/disagg/transport.py), per chunk
+                send: flips a payload byte AFTER the CRC was computed,
+                so the receiver's check fails, the chunk is dropped
+                unacked, and the sender's retransmit timer must heal
+                it. ``every=N`` payload acts on every Nth matched send
+                (the bench's deterministic 1% corruption); filtered by
+                ``transport`` (channel label substring) and ``step``
+                (chunk seq)
+handoff_chunk_drop
+                same site: the send is skipped entirely (wire loss) —
+                consumed a retry attempt, nothing reaches the receiver.
+                Same ``every=`` / ``transport=`` / ``step=`` handling
+transport_stall DataChannel._stalled (serve/disagg/transport.py): parks
+                the channel — no reads, no writes, frames queue — for
+                ``seconds=S`` (default 5) WITHOUT blocking the caller;
+                the router's heartbeat/dispatch loop keeps beating
+                while the transfer watchdog / chunk retry budget
+                decides the transfer's fate. Filtered by ``transport``
 ==============  =======================================================
 
 Spec strings configure the registry, via the ``FMS_FAULTS`` environment
@@ -105,8 +124,9 @@ variable or ``TrainConfig.faults``::
     e.g.  "shard_read:path=quartershard:times=2;nan_loss:step=5:count=3"
 
 Filter params are matched against the call-site context before firing:
-``path`` / ``op`` / ``tier`` / ``corpus`` (substring), ``worker`` /
-``batch`` / ``step`` / ``slice`` / ``proc`` / ``replica`` (equality). A configured filter the call site does not supply in its
+``path`` / ``op`` / ``tier`` / ``corpus`` / ``transport`` (substring),
+``worker`` / ``batch`` / ``step`` / ``slice`` / ``proc`` / ``replica``
+(equality). A configured filter the call site does not supply in its
 context is a non-match (the fault does not fire) — a typo'd filter must
 never degrade into firing everywhere.
 ``times=N`` caps the number of fires (per process; counters are
@@ -130,7 +150,7 @@ ENV_VAR = "FMS_FAULTS"
 # params that filter whether a call-site context matches (vs payload)
 _FILTER_KEYS = (
     "path", "op", "worker", "batch", "step", "tier", "slice", "corpus",
-    "proc", "replica",
+    "proc", "replica", "transport",
 )
 
 

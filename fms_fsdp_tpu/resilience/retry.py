@@ -23,6 +23,17 @@ logger = logging.getLogger(__name__)
 TRANSIENT_EXCEPTIONS = (OSError,)
 
 
+def backoff_delay(
+    attempt: int, backoff_s: float = 0.5, max_backoff_s: float = 30.0
+) -> float:
+    """The repo's one backoff schedule: ``backoff_s * 2^attempt``,
+    capped at ``max_backoff_s``. Shared by the blocking ``retry_call``
+    loop below and the non-blocking chunk retransmit timers in
+    serve/disagg/transport.py (which cannot sleep — the router's
+    dispatch loop runs between retries)."""
+    return min(backoff_s * (2**attempt), max_backoff_s)
+
+
 def retry_call(
     fn: Callable,
     *,
@@ -42,7 +53,7 @@ def retry_call(
         except exceptions as e:
             if attempt >= retries:
                 raise
-            delay = min(backoff_s * (2**attempt), max_backoff_s)
+            delay = backoff_delay(attempt, backoff_s, max_backoff_s)
             attempt += 1
             logger.warning(
                 "transient error in %s (attempt %d/%d, retrying in %.2fs): %s",

@@ -586,6 +586,13 @@ def default_replica_policies() -> Dict[str, RestartPolicy]:
         # and the crash-loop guard still ends a replica that dies
         # repeatedly without serving anything
         "replica_loss": RestartPolicy(backoff=False),
+        # drain-and-migrate (serve/replica.py SIGTERM path): the replica
+        # packed its live streams, shipped them to siblings through the
+        # router, and exited clean with the ``preempted`` registry code.
+        # Planned eviction is not a crash — relaunch immediately, no
+        # backoff (the scheduler that preempted the host decides whether
+        # the relaunch actually lands)
+        "preempted": RestartPolicy(backoff=False),
         "injected_kill": RestartPolicy(),
         "watchdog_stall": RestartPolicy(),
         "anomaly_abort": RestartPolicy(),

@@ -1,10 +1,18 @@
 """Disaggregated serving: prefill/decode role split + KV-page handoff.
 
-The pieces (docs/serving.md "Sharded replicas & disaggregation"):
+The pieces (docs/serving.md "Sharded replicas & disaggregation" and
+"Streaming transport & drain"):
 
 - :mod:`~fms_fsdp_tpu.serve.disagg.handoff` — the PageHandoff codec
   (deterministic wire bytes for a sequence's KV pages + sampling
   state);
+- :mod:`~fms_fsdp_tpu.serve.disagg.slab` — the mamba slab codec (how
+  the recurrent conv/SSD state + hybrid pages are named inside the
+  same FMSH frame);
+- :mod:`~fms_fsdp_tpu.serve.disagg.transport` — the chunked resumable
+  transfer layer (per-chunk CRC + acks, bounded-backoff retransmit,
+  resume-from-journal, in-flight-bytes backpressure) that moves those
+  frames on each replica's dedicated data channel;
 - ``ServeConfig.role`` (serve/engine.py) — what an engine does with an
   admitted request: ``unified`` serves end-to-end, ``prefill`` packs a
   handoff after the first token, ``decode`` additionally accepts
@@ -19,9 +27,18 @@ Role codes mirror FAMILY_CODES: flat numeric obs maps (schema v13
 
 from fms_fsdp_tpu.serve.disagg.handoff import (
     HandoffError,
+    PAGE_CODEC_VERSION,
     WIRE_VERSION,
+    check_codec_version,
     pack_handoff,
     unpack_handoff,
+)
+from fms_fsdp_tpu.serve.disagg.slab import SLAB_CODEC_VERSION
+from fms_fsdp_tpu.serve.disagg.transport import (
+    ChunkReceiver,
+    ChunkSender,
+    DataChannel,
+    TransportError,
 )
 
 ROLE_UNIFIED = "unified"
@@ -31,13 +48,20 @@ ROLES = (ROLE_UNIFIED, ROLE_PREFILL, ROLE_DECODE)
 ROLE_CODES = {ROLE_UNIFIED: 0, ROLE_PREFILL: 1, ROLE_DECODE: 2}
 
 __all__ = [
+    "ChunkReceiver",
+    "ChunkSender",
+    "DataChannel",
     "HandoffError",
+    "PAGE_CODEC_VERSION",
     "ROLES",
     "ROLE_CODES",
     "ROLE_DECODE",
     "ROLE_PREFILL",
     "ROLE_UNIFIED",
+    "SLAB_CODEC_VERSION",
+    "TransportError",
     "WIRE_VERSION",
+    "check_codec_version",
     "pack_handoff",
     "unpack_handoff",
 ]

@@ -44,6 +44,15 @@ import numpy as np
 MAGIC = b"FMSH"
 WIRE_VERSION = 1
 
+# Family codec versions, carried INSIDE the header (``codec`` /
+# ``codec_version``): the FMSH wire version above covers the framing
+# (magic/header/leaves/crc); the codec version covers what the leaves
+# MEAN for a family (page layout for llama/mixtral, slab layout for
+# mamba — serve/disagg/slab.py). A decode replica that does not speak a
+# frame's codec version rejects with a typed HandoffError naming both,
+# and the router requeues the request for re-prefill.
+PAGE_CODEC_VERSION = 1
+
 # storage dtypes a pool leaf may ship in. bf16/fp8 resolve through
 # ml_dtypes (the numpy-side registration jax itself uses).
 _DTYPES = {
@@ -66,6 +75,27 @@ class HandoffError(ValueError):
     shape/quant than the receiving replica's. Typed so the replica can
     reject it back to the router (which requeues through the journal)
     instead of scattering garbage into a live pool."""
+
+
+def check_codec_version(header: Dict, codec: str, version: int) -> None:
+    """Raise a typed :class:`HandoffError` naming BOTH versions when a
+    frame's family codec does not match what this replica speaks —
+    version skew in a mixed-version fleet is a reject-and-requeue
+    (the router re-prefills), never a crash-loop on the resume."""
+    got_codec = header.get("codec")
+    if got_codec != codec:
+        raise HandoffError(
+            f"handoff codec {got_codec!r} != this replica's {codec!r}: "
+            f"the frame was packed by a different family/codec"
+        )
+    got = header.get("codec_version")
+    if got != version:
+        raise HandoffError(
+            f"handoff codec version skew: frame carries {codec!r} "
+            f"version {got!r}, this replica speaks version {version!r} "
+            f"— mixed-version fleet; requeue for re-prefill and "
+            f"upgrade the older replicas"
+        )
 
 
 def pack_handoff(header: Dict, arrays: Dict[str, np.ndarray]) -> bytes:

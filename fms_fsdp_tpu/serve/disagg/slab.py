@@ -1,0 +1,130 @@
+"""Mamba slab handoff codec: the recurrent state's wire layout.
+
+Llama/mixtral ship a stream's whole decode state as KV pages through
+the generic page codec (serve/families/FamilyAdapter.export_handoff).
+Mamba's decode state is not paged: per mamba layer it is a fixed-size
+slab slice — the conv window (compute dtype) plus the fp32 SSD state —
+and, in hybrid configs, ordinary KV pages for the attention layers.
+This module defines how that state is named and checked inside the
+same ``FMSH``-framed, versioned, deterministic wire format
+(serve/disagg/handoff.py::pack_handoff); MambaAdapter's handoff
+overrides (serve/families/mamba.py) do the device reads/writes.
+
+Leaf naming (sorted-name packing order falls out of the zero-padding):
+
+=====================  ================================================
+leaf                   contents
+=====================  ================================================
+``slab.NNNN.conv``     layer NNNN's conv window row, shape
+                       ``(d_conv-1, conv_dim)``, compute dtype
+``slab.NNNN.ssd``      layer NNNN's SSD state row, shape
+                       ``(nheads, headdim, d_state)``, ALWAYS fp32
+                       (the recurrence accumulates there; shipping it
+                       narrower would break bit-parity on resume)
+``kv.k`` / ``kv.v``    hybrid attention-layer pages, exactly the
+                       generic page codec's leaves (hybrid configs
+                       only; mamba pools are unquantized so there are
+                       no scale leaves)
+=====================  ================================================
+
+Only mamba (SSD-mixer) layers appear under ``slab.``; hybrid attention
+layers contribute no slab slice (their state IS the pages). The header
+carries ``codec="mamba_slab"`` + ``codec_version`` (version skew is a
+typed reject, serve/disagg/handoff.py::check_codec_version) and the
+slab geometry, so a mismatched receiver rejects at the door instead of
+scattering a foreign layout into its slab.
+
+jax-free: operates on host numpy arrays and plain dicts.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+SLAB_CODEC_VERSION = 1
+
+_SLAB_PREFIX = "slab."
+_KV_PREFIX = "kv."
+_PARTS = ("conv", "ssd")
+
+
+def slab_leaf_name(layer: int, part: str) -> str:
+    assert part in _PARTS, part
+    return f"{_SLAB_PREFIX}{layer:04d}.{part}"
+
+
+def pack_slab_leaves(
+    layer_states: Dict[int, Dict[str, np.ndarray]],
+    kv_arrays: Optional[Dict[str, np.ndarray]] = None,
+) -> Dict[str, np.ndarray]:
+    """Flatten per-layer slab rows (+ optional hybrid page leaves) into
+    the flat leaf-name -> array dict pack_handoff expects."""
+    arrays: Dict[str, np.ndarray] = {}
+    for layer, parts in layer_states.items():
+        assert set(parts) == set(_PARTS), (layer, sorted(parts))
+        for part in _PARTS:
+            arrays[slab_leaf_name(layer, part)] = parts[part]
+    for name, arr in (kv_arrays or {}).items():
+        arrays[_KV_PREFIX + name] = arr
+    return arrays
+
+
+def split_slab_leaves(
+    arrays: Dict[str, np.ndarray],
+) -> Tuple[Dict[int, Dict[str, np.ndarray]], Dict[str, np.ndarray]]:
+    """The unpack half: flat leaves -> (per-layer slab rows, hybrid
+    page leaves). Unrecognized names are a typed HandoffError — a
+    frame from a different codec must not be half-applied."""
+    from fms_fsdp_tpu.serve.disagg.handoff import HandoffError
+
+    layer_states: Dict[int, Dict[str, np.ndarray]] = {}
+    kv: Dict[str, np.ndarray] = {}
+    for name, arr in arrays.items():
+        if name.startswith(_KV_PREFIX):
+            kv[name[len(_KV_PREFIX):]] = arr
+            continue
+        if not name.startswith(_SLAB_PREFIX):
+            raise HandoffError(
+                f"slab frame carries unrecognized leaf {name!r} "
+                f"(expected 'slab.NNNN.conv/ssd' or 'kv.*')"
+            )
+        rest = name[len(_SLAB_PREFIX):]
+        try:
+            layer_s, part = rest.split(".", 1)
+            layer = int(layer_s)
+        except ValueError:
+            raise HandoffError(
+                f"slab frame leaf {name!r} is not 'slab.NNNN.part'"
+            ) from None
+        if part not in _PARTS:
+            raise HandoffError(
+                f"slab frame leaf {name!r} names unknown part {part!r}"
+            )
+        layer_states.setdefault(layer, {})[part] = arr
+    for layer, parts in layer_states.items():
+        if set(parts) != set(_PARTS):
+            raise HandoffError(
+                f"slab frame layer {layer} ships {sorted(parts)}; "
+                f"both of {_PARTS} are required"
+            )
+    return layer_states, kv
+
+
+def check_slab_header(header: Dict, expected: Dict) -> None:
+    """Raise a typed HandoffError for each geometry field where the
+    frame and this replica disagree. ``expected`` is the receiving
+    adapter's own geometry (same field names as the header)."""
+    from fms_fsdp_tpu.serve.disagg.handoff import (
+        HandoffError,
+        check_codec_version,
+    )
+
+    check_codec_version(header, "mamba_slab", SLAB_CODEC_VERSION)
+    for field, mine in expected.items():
+        if header.get(field) != mine:
+            raise HandoffError(
+                f"slab handoff {field}={header.get(field)!r} does not "
+                f"match this replica's {field}={mine!r}: sending and "
+                f"receiving replicas must share one model config and "
+                f"ServeConfig"
+            )

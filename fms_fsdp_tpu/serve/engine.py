@@ -219,6 +219,10 @@ class ServingEngine:
         self._prefill_tokens = 0
         self._decode_wall = 0.0
         self._finished_buf: List[Request] = []
+        # handoff imports that failed typed AFTER admission (the
+        # adapter freed its allocations): the replica loop drains these
+        # via take_failed() and rejects them back to the router
+        self._failed_buf: List[Request] = []
         self.last_logits = None  # (B, V) of the last decode step (debug)
         self.iterations = 0  # engine step() count (health + fault ctx)
         self._draining = False
@@ -491,9 +495,26 @@ class ServingEngine:
         pages into this pool and restore the stream's decode position —
         no prefill compute at all, which is the disaggregation win (a
         long-prompt prefill never stalls this engine's decode step)."""
+        from fms_fsdp_tpu.serve.disagg import HandoffError
+
         header, arrays, nbytes = req.handoff_in
         t0 = self.clock()
-        ok = self.adapter.import_handoff(req.rid, slot, header, arrays)
+        try:
+            ok = self.adapter.import_handoff(req.rid, slot, header, arrays)
+        except HandoffError as e:
+            # the frame passed the submit-time header check but failed
+            # mid-import (corrupt leaves, geometry drift). The adapter
+            # freed every page and slab slice it allocated — pool
+            # accounting is back to its pre-import value — so fail the
+            # request typed instead of crashing the replica; the
+            # router clears the journaled frame and requeues it for
+            # re-prefill
+            req.handoff_in = None
+            req.state = "failed"
+            req.fail_reason = f"handoff_error: {e}"
+            self._failed_buf.append(req)
+            self.registry.counter("serve.handoffs_failed").add()
+            return
         assert ok, "admission checked capacity; scatter cannot fail here"
         self._handoff_wall += self.clock() - t0
         self._handoff_bytes += nbytes
@@ -515,7 +536,7 @@ class ServingEngine:
         from fms_fsdp_tpu.serve.disagg import pack_handoff
 
         t0 = self.clock()
-        header, arrays = self.adapter.export_handoff(req.rid)
+        header, arrays = self.adapter.export_handoff(req.rid, slot)
         header.update(
             prompt=[int(t) for t in req.prompt],
             generated=[int(t) for t in req.generated],
@@ -729,6 +750,49 @@ class ServingEngine:
         ``drained`` flips once the slots empty."""
         self._draining = True
 
+    def take_failed(self) -> List[Request]:
+        """Requests that failed typed after admission (a handoff
+        import rejected mid-apply) — the replica loop emits these as
+        ``handoff_error`` rejects so the router requeues them for
+        re-prefill instead of counting them served."""
+        out, self._failed_buf = self._failed_buf, []
+        return out
+
+    def live_requests(self) -> List[Request]:
+        """The running (slot-holding) streams, admission order — what
+        drain-and-migrate must pack before the process exits."""
+        return [r for r in self._admit_order if r in self._slots]
+
+    def pack_stream(self, req: Request) -> Optional[bytes]:
+        """Pack a LIVE decode stream's state into handoff wire bytes
+        WITHOUT retiring it — the drain-and-migrate read: a SIGTERM'd
+        replica packs each running stream and ships it to a sibling so
+        a planned eviction costs zero recompute (the stream resumes
+        mid-decode there via ``submit_handoff``). Returns None for
+        streams that cannot travel: mid-chunked-prefill (the staged
+        prompt is not in the frame) or a speculative engine (the draft
+        state is not in the frame) — those fall back to the router's
+        requeue/recompute path."""
+        from fms_fsdp_tpu.serve.disagg import pack_handoff
+
+        if not self.adapter.supports_handoff or self.adapter.speculative:
+            return None
+        if req.rid in self._chunking or req not in self._slots:
+            return None
+        slot = self._slots.index(req)
+        header, arrays = self.adapter.export_handoff(req.rid, slot)
+        header.update(
+            prompt=[int(t) for t in req.prompt],
+            generated=[int(t) for t in req.generated],
+            seq_len=int(self._lens[slot]),
+            max_new_tokens=int(req.max_new_tokens),
+        )
+        data = pack_handoff(header, arrays)
+        self._handoff_bytes += len(data)
+        self.registry.counter("serve.handoffs_exported").add()
+        self.registry.counter("serve.handoff_bytes").add(len(data))
+        return data
+
     @property
     def draining(self) -> bool:
         return self._draining
@@ -807,6 +871,10 @@ class ServingEngine:
             "spec_draft_tokens": float(self.adapter.spec_draft_tokens),
             "prefill_chunks": float(self._prefill_chunks),
             "paged_kernel_impl": float(self._paged_kernel_impl()),
+            # v15: drain-and-migrate — 1.0 once a draining engine's
+            # slots have emptied (its streams finished or were packed
+            # and migrated to siblings)
+            "drained": float(self.drained),
         }
 
     def _paged_kernel_impl(self) -> int:

@@ -208,10 +208,11 @@ class FamilyAdapter:
     ``supports_handoff`` and inherit the base ``export_handoff`` /
     ``import_handoff`` (the whole transferable state IS the page set,
     so the generic pool gather/scatter covers llama and mixtral
-    identically); families with non-page decode state (the mamba slab)
-    leave it False and the engine rejects prefill/decode roles at
-    build with the fix spelled out. ``supports_layout`` gates
-    ``ServeConfig.serve_layout`` the same way.
+    identically); mamba's non-page decode state travels through its
+    own slab codec (serve/disagg/slab.py — conv window + fp32 SSD
+    state + hybrid-layer pages), overriding all three methods.
+    ``supports_layout`` gates ``ServeConfig.serve_layout`` the same
+    way.
     """
 
     family: str = "?"
@@ -348,19 +349,25 @@ class FamilyAdapter:
 
     # -- disaggregation (generic paged implementation) ---------------------
 
-    def export_handoff(self, rid: int):
+    def export_handoff(self, rid: int, slot: "Optional[int]" = None):
         """Read rid's transferable decode state: returns (header
         fields, leaf arrays) for serve/disagg/handoff.py::pack_handoff.
         The generic implementation ships the sequence's KV pages in
         storage dtype; the engine adds the sampling fields (prompt,
-        generated) before packing."""
+        generated) before packing. ``slot`` is the stream's batch slot
+        — unused here (the page set is keyed by rid), required by
+        families with slot-indexed state (the mamba slab)."""
         assert self.supports_handoff and self.cache is not None, (
             f"{self.family} does not support page handoff"
         )
+        from fms_fsdp_tpu.serve.disagg.handoff import PAGE_CODEC_VERSION
+
         cache = self.cache
         return (
             {
                 "family": self.family,
+                "codec": "pages",
+                "codec_version": PAGE_CODEC_VERSION,
                 "quant": cache.quant,
                 "page_size": cache.page_size,
                 "n_kv_heads": cache.n_kv_heads,
@@ -379,10 +386,15 @@ class FamilyAdapter:
         submit (fail the resume at the door) and again by
         ``import_handoff`` (belt and braces for direct callers)."""
         from fms_fsdp_tpu.serve.disagg import HandoffError
+        from fms_fsdp_tpu.serve.disagg.handoff import (
+            PAGE_CODEC_VERSION,
+            check_codec_version,
+        )
 
         assert self.supports_handoff and self.cache is not None, (
             f"{self.family} does not support page handoff"
         )
+        check_codec_version(header, "pages", PAGE_CODEC_VERSION)
         cache = self.cache
         for field, mine in (
             ("family", self.family),

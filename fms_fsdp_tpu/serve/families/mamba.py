@@ -18,6 +18,16 @@ expiry and completion all land there), and the jitted decode step masks
 its state writes to live rows, so an idle slot's slab stays exactly
 zero between streams — recompute-on-resume then re-prefills the full
 resumed prompt into a clean slice.
+
+Handoff: a stream's slab slice travels through the mamba slab codec
+(serve/disagg/slab.py) — per mamba layer the conv window (compute
+dtype) and the fp32 SSD state, plus the hybrid attention layers' KV
+pages via the shared paged pool — in the same FMSH-framed versioned
+wire format llama/mixtral use for pages. That enables disaggregated
+prefill/decode for mamba and, more importantly, drain-and-migrate: a
+SIGTERM'd replica packs its live mamba streams and ships them to
+siblings at zero recompute cost (docs/serving.md "Streaming transport
+& drain").
 """
 
 from functools import partial
@@ -29,10 +39,17 @@ import numpy as np
 
 from fms_fsdp_tpu.models.generation import sample_token
 from fms_fsdp_tpu.models.mamba import (
+    _conv_dim,
     init_mamba_decode_state,
     mamba_decode_step,
     mamba_prefill,
     mamba_state_bytes_per_stream,
+)
+from fms_fsdp_tpu.serve.disagg.slab import (
+    SLAB_CODEC_VERSION,
+    check_slab_header,
+    pack_slab_leaves,
+    split_slab_leaves,
 )
 from fms_fsdp_tpu.serve.families import FamilyAdapter
 from fms_fsdp_tpu.serve.kv_cache import RESERVED_PAGES, PagedKVCache
@@ -40,6 +57,7 @@ from fms_fsdp_tpu.serve.kv_cache import RESERVED_PAGES, PagedKVCache
 
 class MambaAdapter(FamilyAdapter):
     family = "mamba"
+    supports_handoff = True  # via the slab codec, not the page codec
 
     def __init__(self, params, model_cfg, scfg, compute_dtype=None):
         from fms_fsdp_tpu.serve.engine import _DTYPES
@@ -266,6 +284,127 @@ class MambaAdapter(FamilyAdapter):
         )
         self.cache.pools = pools
         return np.asarray(toks), logits
+
+    # -- disaggregation: the slab codec (serve/disagg/slab.py) -------------
+
+    def _slab_geometry(self) -> Dict:
+        """The geometry fields the slab header carries and
+        check_handoff_header compares — JSON-native types only (the
+        header round-trips through canonical JSON)."""
+        cfg = self.model_cfg
+        geo = {
+            "family": self.family,
+            "compute_dtype": jnp.dtype(self.compute_dtype).name,
+            "n_layer": int(cfg.n_layer),
+            "attn_layers": sorted(int(i) for i in cfg.attn_layer_idx),
+            "conv_shape": [int(cfg.d_conv - 1), int(_conv_dim(cfg))],
+            "ssd_shape": [
+                int(cfg.nheads), int(cfg.headdim), int(cfg.d_state)
+            ],
+        }
+        if self._hybrid:
+            geo.update(
+                quant=self.cache.quant,
+                page_size=self.cache.page_size,
+                n_kv_heads=self.cache.n_kv_heads,
+                head_dim=self.cache.head_dim,
+                n_attn_layers=self.cache.n_layers,
+            )
+        return geo
+
+    def export_handoff(self, rid: int, slot: Optional[int] = None):
+        assert slot is not None, "mamba slab export needs the stream's slot"
+        layer_states = {
+            i: {
+                "conv": np.asarray(layer["conv"][slot]),
+                "ssd": np.asarray(layer["ssd"][slot]),
+            }
+            for i, layer in enumerate(self._state)
+            if layer
+        }
+        kv = self.cache.gather_pages(rid) if self._hybrid else None
+        header = dict(self._slab_geometry())
+        header.update(
+            codec="mamba_slab",
+            codec_version=SLAB_CODEC_VERSION,
+            alloc_tokens=self.cache.tokens_of(rid) if self._hybrid else 0,
+        )
+        return header, pack_slab_leaves(layer_states, kv)
+
+    def check_handoff_header(self, header) -> None:
+        check_slab_header(header, self._slab_geometry())
+
+    def import_handoff(self, rid: int, slot: int, header, arrays) -> bool:
+        from fms_fsdp_tpu.serve.disagg.handoff import HandoffError
+
+        self.check_handoff_header(header)
+        layer_states, kv = split_slab_leaves(arrays)
+        # validate everything validatable BEFORE any allocation: a
+        # frame rejected after pages/slab were touched must not leak
+        expected_layers = {
+            i for i, layer in enumerate(self._state) if layer
+        }
+        if set(layer_states) != expected_layers:
+            raise HandoffError(
+                f"slab frame covers layers {sorted(layer_states)}; "
+                f"this replica's mamba layers are "
+                f"{sorted(expected_layers)}"
+            )
+        for i in expected_layers:
+            for part in ("conv", "ssd"):
+                want = tuple(
+                    int(d) for d in self._state[i][part].shape[1:]
+                )
+                got = tuple(layer_states[i][part].shape)
+                if got != want:
+                    raise HandoffError(
+                        f"slab leaf layer {i} {part!r} has shape "
+                        f"{got}, this replica expects {want}"
+                    )
+        if self._hybrid:
+            if not kv:
+                raise HandoffError(
+                    "hybrid mamba handoff is missing its attention-"
+                    "layer 'kv.*' page leaves"
+                )
+            if not self.cache.scatter_pages(
+                rid, kv, int(header["alloc_tokens"])
+            ):
+                return False  # pool full right now: engine defers
+        elif kv:
+            raise HandoffError(
+                "non-hybrid mamba handoff carries attention page "
+                "leaves this replica has no pool for"
+            )
+        try:
+            new_state = list(self._state)
+            for i in expected_layers:
+                layer = new_state[i]
+                new_state[i] = {
+                    "conv": layer["conv"].at[slot].set(
+                        jnp.asarray(
+                            layer_states[i]["conv"], layer["conv"].dtype
+                        )
+                    ),
+                    "ssd": layer["ssd"].at[slot].set(
+                        jnp.asarray(layer_states[i]["ssd"], jnp.float32)
+                    ),
+                }
+            self._state = new_state
+        except Exception as e:
+            # free the decode-side pages and re-zero the slab slice
+            # this import touched — pool accounting must return to its
+            # pre-import value
+            if self._hybrid:
+                self.cache.free(rid)
+            self._state = jax.tree.map(
+                lambda s: s.at[slot].set(0), self._state
+            )
+            raise HandoffError(
+                f"slab import failed after allocation (pages freed, "
+                f"slab slice zeroed): {e}"
+            ) from e
+        return True
 
     # -- obs ---------------------------------------------------------------
 

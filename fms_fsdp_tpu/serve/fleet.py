@@ -35,6 +35,34 @@ requeues to re-prefill; a decode replica that dies after accepting one
 requeues WITH the journaled bytes and the resume replays on a sibling —
 exactly-once either way, through the same dedup gate as ``done``.
 
+Transport (``FleetConfig.handoff_transport``): with ``"chunked"`` (the
+default) handoff frames do NOT ride the stdio control plane — each
+replica gets a dedicated data channel (a socketpair created at spawn,
+the child's end passed by fd) and frames move as fixed-size,
+CRC-checked, individually-acked chunks with bounded-backoff retransmit
+and an in-flight-bytes cap (serve/disagg/transport.py). The control
+messages (``handoff``/``migrate`` out of a replica, ``resume`` into
+one) then carry only the transfer metadata (``transfer_id``/``total``/
+``bytes``) and stdio stays heartbeat-sized — a 4x-context handoff can
+never stall the router's dispatch loop behind one giant line. The
+router journals chunk-level progress (``transfer_begin``/``chunk_ack``/
+``transfer_complete`` events) so an interrupted outbound transfer to a
+still-live incarnation resumes by retransmitting ONLY the unacked
+chunks (``ChunkSender(acked=...)``); a transfer whose receiver died is
+aborted and re-sent whole on redispatch (the new incarnation has
+nothing). ``"blob"`` keeps the original single-message base64 relay —
+byte-identical frames, the codec is shared.
+
+Drain-and-migrate (:meth:`FleetRouter.preempt`): a planned eviction
+SIGTERMs the replica instead of SIGKILLing it. The replica stops
+admitting, hands queued rids back (``returned``), packs each live
+decode stream — llama/mixtral via the page codec, mamba via the slab
+codec (serve/disagg/slab.py) — and ships them to the router as
+``migrate`` transfers, then exits clean (``preempted``, relaunched
+without backoff). A migrated stream is re-journaled exactly like a
+prefill handoff and resumes on a sibling replica with ZERO recompute;
+unplanned death (SIGKILL) keeps the requeue/recompute path.
+
 Durability lives at the ROUTER, not the replicas: a request is journaled
 at admission (:class:`RequestJournal`) and every state transition —
 assigned to replica K incarnation ``run_id``, completed with tokens,
@@ -85,17 +113,30 @@ stay importable in thin supervisor processes (and the
 registry's crash-path classifier).
 """
 
+import base64
 import json
 import os
+import socket as _socketlib
 import subprocess
+import sys as _sys
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass
 from queue import Empty, Queue
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from fms_fsdp_tpu.resilience.supervisor import ReplicaSetSupervisor
+from fms_fsdp_tpu.serve.disagg.transport import (
+    KIND_ACK,
+    ChunkReceiver,
+    ChunkSender,
+    DataChannel,
+    TransportError,
+    ensure_transfer_ids_above,
+    next_transfer_id,
+    split_payload,
+)
 from fms_fsdp_tpu.serve.scheduler import (
     REJECT_DEADLINE_UNMEETABLE,
     REJECT_OVERLOADED,
@@ -172,10 +213,25 @@ class RequestJournal:
 
     Exactly-once completion: :meth:`complete` returns False (and
     counts a duplicate) when the rid is already terminal — the dedup
-    point that makes replica-death-after-emit safe."""
+    point that makes replica-death-after-emit safe.
+
+    Chunk-level transfer progress (``transfer_begin``/``chunk_ack``/
+    ``transfer_complete`` events, mirrored in :attr:`transfers`) makes
+    partial state transfers resumable: a sender rebuilt over
+    :meth:`transfer_acks` retransmits only the unacked chunks.
+
+    ``resume=True`` replays an existing event log before appending:
+    records are rebuilt, terminal rids stay terminal (the dedup gate
+    survives the relaunch), non-terminal rids requeue, and in-flight
+    chunk progress is restored. A torn TRAILING line (the crash
+    happened mid-append) is truncated with a warning; a torn line with
+    valid records after it means the file is corrupt and replay raises.
+    Handoff/token payloads are not journaled — a replayed rid that had
+    handed off re-prefills from its prompt (which IS journaled)."""
 
     def __init__(
-        self, path: str = "", clock: Callable[[], float] = time.monotonic
+        self, path: str = "", clock: Callable[[], float] = time.monotonic,
+        resume: bool = False,
     ):
         self.path = path
         self.clock = clock
@@ -186,16 +242,160 @@ class RequestJournal:
         self._next_rid = 0
         self.duplicates_dropped = 0
         self.requeued_total = 0
+        # transfer_id -> {"rid", "total", "kind", "run_id", "acked" set}
+        self.transfers: Dict[int, dict] = {}
+        self.torn_tail_dropped = 0
         self._fh = None
+        replayed = []
+        if path and resume and os.path.exists(path):
+            replayed = self._read_for_replay(path)
         if path:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
             self._fh = open(path, "a")
+        if replayed:
+            self._apply_replay(replayed)
 
-    def _event(self, kind: str, rid: int, **extra) -> None:
+    # -- replay (router relaunch over an existing journal) -----------------
+
+    def _read_for_replay(self, path: str) -> List[dict]:
+        """Parse the event log, tolerating one torn line AT THE TAIL
+        (truncate-and-warn — a crash mid-append tears at most the last
+        record). A torn line followed by valid records is real
+        corruption: refuse to replay rather than silently skip."""
+        with open(path, "rb") as f:
+            raw = f.read()
+        lines = raw.split(b"\n")
+        events: List[dict] = []
+        keep_upto = 0  # byte offset of the last clean record boundary
+        off = 0
+        for i, line in enumerate(lines):
+            nxt = off + len(line) + 1
+            if line.strip():
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    tail = b"".join(
+                        ln for ln in lines[i + 1:] if ln.strip()
+                    )
+                    if tail:
+                        raise ValueError(
+                            f"journal {path}: torn record at line "
+                            f"{i + 1} with valid records after it — "
+                            f"corrupt log, refusing to replay"
+                        ) from None
+                    _sys.stderr.write(
+                        f"[request-journal] WARNING: {path} ends in a "
+                        f"torn record (line {i + 1}, "
+                        f"{len(line)} bytes) — dropped; events up to "
+                        f"the last clean boundary replay\n"
+                    )
+                    self.torn_tail_dropped = 1
+                    with open(path, "wb") as f:
+                        f.write(raw[:keep_upto])
+                    return events
+            keep_upto = min(nxt, len(raw))
+            off = nxt
+        return events
+
+    def _apply_replay(self, events: List[dict]) -> None:
+        for ev in events:
+            kind = ev.get("event")
+            rid = ev.get("rid")
+            if kind == "transfer_begin":
+                self.transfers[ev["transfer_id"]] = {
+                    "rid": rid,
+                    "total": int(ev.get("total", 0)),
+                    "kind": ev.get("kind", "resume"),
+                    "run_id": ev.get("run_id", ""),
+                    "acked": set(),
+                }
+                continue
+            if kind == "chunk_ack":
+                t = self.transfers.get(ev["transfer_id"])
+                if t is not None:
+                    t["acked"].add(int(ev["seq"]))
+                continue
+            if kind in ("transfer_complete", "transfer_abort"):
+                self.transfers.pop(ev["transfer_id"], None)
+                continue
+            if kind == "duplicate_dropped":
+                self.duplicates_dropped += 1
+                continue
+            if kind == "admit":
+                rec = JournalRecord(
+                    rid=rid,
+                    prompt=list(ev.get("prompt", [])),
+                    max_new_tokens=int(ev.get("max_new_tokens", 0)),
+                    deadline_s=ev.get("deadline_s"),
+                    submit_t=ev.get("t", 0.0),
+                )
+                self.records[rid] = rec
+                self._next_rid = max(self._next_rid, rid + 1)
+                continue
+            rec = self.records.get(rid)
+            if rec is None:
+                continue
+            if kind == "assign":
+                rec.state = J_ASSIGNED
+                rec.replica = ev.get("replica")
+                rec.run_id = ev.get("run_id", "")
+            elif kind == "complete":
+                rec.state = J_COMPLETED
+                rec.finish_t = ev.get("t")
+            elif kind == "handoff":
+                # the wire bytes are not journaled: the replayed rid
+                # re-prefills from its prompt (counted, not resurrected)
+                rec.state = J_QUEUED
+                rec.replica = None
+                rec.run_id = ""
+                rec.handoff = None
+                rec.handoff_bytes = int(ev.get("bytes", 0))
+                rec.handoffs += 1
+            elif kind in ("requeue", "returned", "reprefill"):
+                rec.state = J_QUEUED
+                rec.replica = None
+                rec.run_id = ""
+                if kind == "requeue":
+                    rec.requeues += 1
+                    self.requeued_total += 1
+                if kind == "reprefill":
+                    rec.handoff = None
+                    rec.handoff_bytes = 0
+            elif kind == "fail":
+                rec.state = J_FAILED
+                rec.fail_reason = ev.get("reason", "")
+                rec.finish_t = ev.get("t")
+            elif kind == "expire":
+                rec.state = J_EXPIRED
+                rec.finish_t = ev.get("t")
+        # every incarnation of the previous process is gone: requeue
+        # what was assigned (new events — the log stays append-only)
+        for rid in sorted(self.records):
+            rec = self.records[rid]
+            if rec.state == J_ASSIGNED:
+                from_run = rec.run_id
+                rec.state = J_QUEUED
+                rec.replica = None
+                rec.run_id = ""
+                rec.requeues += 1
+                self.requeued_total += 1
+                self._event("requeue", rid, from_run_id=from_run,
+                            by="replay")
+        self.queued = deque(
+            rid for rid in sorted(self.records)
+            if self.records[rid].state == J_QUEUED
+        )
+        if self.transfers:
+            ensure_transfer_ids_above(max(self.transfers))
+
+    def _event(self, event: str, rid: int, **extra) -> None:
+        # first arg deliberately named ``event``: payloads may carry a
+        # ``kind=`` field of their own (transfer_begin, duplicate
+        # handoff drops)
         if self._fh is None:
             return
-        rec = {"event": kind, "rid": rid, "t": self.clock(), **extra}
+        rec = {"event": event, "rid": rid, "t": self.clock(), **extra}
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
 
@@ -223,8 +423,13 @@ class RequestJournal:
         )
         self.records[rid] = rec
         self.queued.append(rid)
-        self._event("admit", rid, prompt_len=len(rec.prompt),
-                    max_new_tokens=rec.max_new_tokens)
+        # the prompt itself is journaled: replay after a router relaunch
+        # must be able to re-dispatch (recompute-on-resume needs the
+        # tokens, not just their count)
+        self._event("admit", rid, prompt=rec.prompt,
+                    prompt_len=len(rec.prompt),
+                    max_new_tokens=rec.max_new_tokens,
+                    deadline_s=rec.deadline_s)
         return rid
 
     def assign(self, rid: int, replica: int, run_id: str) -> JournalRecord:
@@ -366,6 +571,83 @@ class RequestJournal:
         self.queued.appendleft(rid)
         self._event("returned", rid)
 
+    def reprefill(self, rid: int, reason: str = "") -> bool:
+        """A decode replica rejected this rid's journaled handoff with a
+        typed ``handoff_error`` (codec/version skew, import failure):
+        the bytes are unusable for this fleet. Drop them and requeue at
+        the FRONT for a fresh prefill — re-dispatching the same bytes
+        would crash-loop the resume, and failing terminally would drop
+        a request the fleet can still serve."""
+        rec = self.records.get(rid)
+        if rec is None or rec.state in (J_COMPLETED, J_EXPIRED, J_FAILED):
+            return False
+        if rec.state == J_ASSIGNED:
+            self._inflight.get(rec.run_id, set()).discard(rid)
+        elif rec.state == J_QUEUED:
+            try:
+                self.queued.remove(rid)
+            except ValueError:
+                pass
+        rec.state = J_QUEUED
+        rec.replica = None
+        rec.run_id = ""
+        rec.handoff = None
+        rec.handoff_bytes = 0
+        rec.requeues += 1
+        self.requeued_total += 1
+        self.queued.appendleft(rid)
+        self._event("reprefill", rid, reason=reason)
+        return True
+
+    # -- chunk-level transfer progress -------------------------------------
+
+    def transfer_begin(
+        self, rid: int, transfer_id: int, total: int, nbytes: int,
+        kind: str = "resume", run_id: str = "",
+    ) -> None:
+        self.transfers[transfer_id] = {
+            "rid": rid,
+            "total": int(total),
+            "kind": kind,
+            "run_id": run_id,
+            "acked": set(),
+        }
+        self._event("transfer_begin", rid, transfer_id=transfer_id,
+                    total=int(total), bytes=int(nbytes), kind=kind,
+                    run_id=run_id)
+
+    def chunk_ack(self, rid: int, transfer_id: int, seq: int) -> None:
+        t = self.transfers.get(transfer_id)
+        if t is not None:
+            t["acked"].add(int(seq))
+        self._event("chunk_ack", rid, transfer_id=transfer_id,
+                    seq=int(seq))
+
+    def transfer_complete(self, rid: int, transfer_id: int) -> None:
+        self.transfers.pop(transfer_id, None)
+        self._event("transfer_complete", rid, transfer_id=transfer_id)
+
+    def transfer_acks(self, transfer_id: int) -> Set[int]:
+        """The journaled acked-seq set — the seed that lets a rebuilt
+        sender retransmit only what the receiver never confirmed."""
+        t = self.transfers.get(transfer_id)
+        return set(t["acked"]) if t is not None else set()
+
+    def abort_transfers(self, run_id: str) -> List[int]:
+        """Void every in-flight transfer whose receiving incarnation
+        died: its chunk progress is meaningless against the relaunched
+        incarnation's empty receiver (resume-with-seed is only sound
+        toward the SAME incarnation)."""
+        gone = [
+            tid for tid, t in self.transfers.items()
+            if t.get("run_id") == run_id
+        ]
+        for tid in gone:
+            t = self.transfers.pop(tid)
+            self._event("transfer_abort", t["rid"], transfer_id=tid,
+                        run_id=run_id)
+        return gone
+
     # -- queries -----------------------------------------------------------
 
     def inflight(self, run_id: str) -> int:
@@ -391,24 +673,45 @@ class SubprocessReplica:
     The reader thread (daemon) parses line-delimited JSON; it exits when
     the child's stdout closes. ``recv`` drains whatever has arrived —
     including after death, which is exactly what the router's
-    drain-before-requeue step needs."""
+    drain-before-requeue step needs.
+
+    ``data_channel_label`` switches on the chunked transport: a
+    socketpair is created here, the child's end rides ``--data-fd`` +
+    ``pass_fds``, and the parent's end is wrapped in a
+    :class:`~fms_fsdp_tpu.serve.disagg.transport.DataChannel` exposed
+    as :attr:`data_channel` (the label is the ``transport=`` fault
+    filter key for the ROUTER side of this replica's wire)."""
 
     def __init__(
         self,
         argv: Sequence[str],
         env: Optional[Dict[str, str]] = None,
         stderr_path: Optional[str] = None,
+        data_channel_label: str = "",
     ):
         self._stderr_f = (
             open(stderr_path, "ab") if stderr_path else subprocess.DEVNULL
         )
+        self.data_channel: Optional[DataChannel] = None
+        child_sock = None
+        pass_fds = ()
+        if data_channel_label:
+            parent_sock, child_sock = _socketlib.socketpair()
+            argv = list(argv) + ["--data-fd", str(child_sock.fileno())]
+            pass_fds = (child_sock.fileno(),)
         self.proc = subprocess.Popen(
             list(argv),
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=self._stderr_f,
             env=env,
+            pass_fds=pass_fds,
         )
+        if child_sock is not None:
+            child_sock.close()  # the child holds its own copy now
+            self.data_channel = DataChannel(
+                parent_sock, label=data_channel_label
+            )
         self._msgs: Queue = Queue()
         self._reader = threading.Thread(
             target=self._read_loop, daemon=True
@@ -465,7 +768,18 @@ class SubprocessReplica:
         except OSError:
             pass
 
+    def terminate(self) -> None:
+        """SIGTERM — the drain-and-migrate preemption notice (the
+        replica packs its live streams and exits ``preempted``), as
+        opposed to ``kill``'s SIGKILL (unplanned death, requeue path)."""
+        try:
+            self.proc.terminate()
+        except OSError:
+            pass
+
     def close(self) -> None:
+        if self.data_channel is not None:
+            self.data_channel.close()
         if self._stderr_f is not subprocess.DEVNULL:
             try:
                 self._stderr_f.close()
@@ -484,6 +798,7 @@ def make_subprocess_spawn(
     env_extra: Optional[Dict[str, str]] = None,
     python: Optional[str] = None,
     prefill_replicas: int = 0,
+    transport: str = "chunked",
 ):
     """Build the supervisor spawn callback for real
     ``serve/replica.py`` children. Writes the model/serve config JSONs
@@ -501,9 +816,10 @@ def make_subprocess_spawn(
     ``times=1`` kill spec inherited by the relaunched incarnation would
     fire again at the same iteration and crash-loop the replica the
     soak meant to kill once. Relaunches get the spec stripped — the
-    relaunched incarnation must be healthy, that is the point."""
-    import sys as _sys
+    relaunched incarnation must be healthy, that is the point.
 
+    ``transport="chunked"`` gives every incarnation a data channel
+    (``--data-fd``); ``"blob"`` keeps the stdio base64 relay."""
     os.makedirs(workdir, exist_ok=True)
     mpath = os.path.join(workdir, "model_cfg.json")
     spath = os.path.join(workdir, "serve_cfg.json")
@@ -547,6 +863,9 @@ def make_subprocess_spawn(
             stderr_path=os.path.join(
                 workdir, f"{ctx['run_id']}.stderr"
             ),
+            data_channel_label=(
+                f"rtr{ctx['replica']}" if transport == "chunked" else ""
+            ),
         )
 
     return spawn
@@ -581,6 +900,21 @@ class FleetConfig:
     # (the v1 fleet). Fresh rids dispatch only to prefill replicas,
     # handoff-carrying rids only to decode replicas.
     prefill_replicas: int = 0
+    # state-transfer transport (serve/disagg/transport.py): "chunked"
+    # moves handoff/migrate frames on each replica's dedicated data
+    # channel as CRC-checked, acked, retried chunks; "blob" keeps the
+    # single-message base64 relay on stdio (byte-identical frames —
+    # the codec is shared, pinned by tests/test_transport.py)
+    handoff_transport: str = "chunked"
+    transport_chunk_bytes: int = 64 * 1024
+    transport_inflight_bytes: int = 256 * 1024  # backpressure cap
+    transport_retries: int = 5  # per chunk, exponential backoff
+    transport_backoff_s: float = 0.05
+    # replay an existing journal_path event log at startup (router
+    # relaunch): terminal rids stay terminal, assigned rids requeue,
+    # chunk progress restores; a torn trailing line truncates with a
+    # warning
+    journal_resume: bool = False
 
 
 class FleetRouter:
@@ -606,7 +940,9 @@ class FleetRouter:
         self._log = log or (
             lambda msg: print(f"[fleet-router] {msg}", flush=True)
         )
-        self.journal = RequestJournal(cfg.journal_path, clock=clock)
+        self.journal = RequestJournal(
+            cfg.journal_path, clock=clock, resume=cfg.journal_resume
+        )
         self.supervisor = ReplicaSetSupervisor(
             spawn,
             cfg.n_replicas,
@@ -629,6 +965,19 @@ class FleetRouter:
         self.expired = 0
         self.failed = 0
         self.handoffs = 0  # handoff messages journaled (incl. repeats)
+        # chunked transport state: outbound resume senders
+        # (transfer_id -> (replica_idx, ChunkSender, rid)) and inbound
+        # handoff/migrate reassembly ((replica_idx, transfer_id) ->
+        # [ChunkReceiver, control-msg-or-None] — chunks can race ahead
+        # of the stdio control message naming them)
+        self._tx: Dict[int, Tuple[int, ChunkSender, int]] = {}
+        self._rx: Dict[Tuple[int, int], list] = {}
+        self._draining: Set[int] = set()  # preempted, excluded from dispatch
+        self.handoff_retries = 0  # transfers that needed >= 1 retransmit
+        self.chunks_resent = 0  # total retransmitted chunks (router side)
+        self.transfers_resumed = 0  # continued past an interruption
+        self.drain_migrations = 0  # live streams migrated off a preempt
+        self.handoff_reprefills = 0  # typed handoff_error -> re-prefill
         self._started = False
         if not 0 <= cfg.prefill_replicas < max(1, cfg.n_replicas):
             raise ValueError(
@@ -661,6 +1010,24 @@ class FleetRouter:
         while self.supervisor.live_indices() and self.clock() < deadline:
             self.poll()
             time.sleep(0.01)
+
+    def preempt(self, idx: int) -> None:
+        """Planned eviction of one replica: SIGTERM (drain-and-migrate
+        notice) and stop dispatching to it. The replica packs each live
+        decode stream (llama/mixtral pages, mamba slab) and ships them
+        back as ``migrate`` transfers — re-journaled like handoffs,
+        they resume on siblings with zero recompute — then exits clean
+        (``preempted``) and the keep-N policy relaunches it."""
+        handle = self.supervisor.handle(idx)
+        if handle is None:
+            return
+        self._draining.add(idx)
+        self._log(f"replica {idx} preempted: drain-and-migrate (SIGTERM)")
+        terminate = getattr(handle, "terminate", None)
+        if terminate is not None:
+            terminate()
+        else:
+            handle.send({"type": "drain"})  # signal-less test double
 
     def shutdown(self) -> None:
         self.supervisor.stop_all()
@@ -722,13 +1089,31 @@ class FleetRouter:
             if ev["event"] == "died":
                 # drain the dead incarnation's surviving output FIRST:
                 # completions that escaped before death deliver
-                # exactly once instead of recomputing
+                # exactly once instead of recomputing — and a preempted
+                # replica's final migrate chunks may still sit in its
+                # data-channel socket buffer
                 handle = ev.get("handle")
                 if handle is not None:
                     delivered.extend(
                         self._process_msgs(idx, handle.drain_final())
                     )
+                    ch = getattr(handle, "data_channel", None)
+                    if ch is not None:
+                        self._pump_channel_msgs(idx, ch)
+                        self._finish_rx(idx)
                     handle.close()
+                # outbound transfers to the dead incarnation are void:
+                # the relaunched incarnation's receiver holds nothing,
+                # so the rid re-sends whole on redispatch
+                for tid in [
+                    t for t, e in self._tx.items() if e[0] == idx
+                ]:
+                    _, sender, _rid = self._tx.pop(tid)
+                    self.chunks_resent += sender.chunks_resent
+                self.journal.abort_transfers(ev["run_id"])
+                for key in [k for k in self._rx if k[0] == idx]:
+                    del self._rx[key]
+                self._draining.discard(idx)
                 requeued = self.journal.requeue_incarnation(ev["run_id"])
                 if requeued:
                     self._log(
@@ -740,15 +1125,24 @@ class FleetRouter:
             elif ev["event"] == "relaunched":
                 self._last_hb[idx] = now
                 self._ready[idx] = False
+                self._draining.discard(idx)
             elif ev["event"] == "gave_up":
                 self._log(ev["post_mortem"])
 
-        # 2) live replicas: drain protocol messages
+        # 2) live replicas: drain protocol messages, then the data
+        # plane (chunk/ack frames, outbound sender timers, completed
+        # reassemblies)
         for idx in self.supervisor.live_indices():
             handle = self.supervisor.handle(idx)
             if handle is None:
                 continue
             delivered.extend(self._process_msgs(idx, handle.recv()))
+            ch = getattr(handle, "data_channel", None)
+            if ch is not None:
+                self._pump_channel_msgs(idx, ch)
+        self._pump_senders()
+        for idx in {k[0] for k in self._rx}:
+            self._finish_rx(idx)
 
         # 3) stall watchdog: a READY replica owning in-flight work that
         # has not heartbeat within stall_timeout_s is wedged — kill it
@@ -833,26 +1227,149 @@ class FleetRouter:
                         rec.engine_ttft = msg.get("ttft")
                     self.completed.append(rec)
                     delivered.append(rec)
-            elif t == "handoff":
-                if self.journal.handoff(
-                    msg["rid"], msg["data"], msg.get("bytes", 0)
-                ):
-                    self.handoffs += 1
-                    rec = self.journal.records[msg["rid"]]
-                    rec.engine_ttft = msg.get("ttft")
+            elif t in ("handoff", "migrate"):
+                if "data" in msg:
+                    # blob transport: the frame rides the control line
+                    self._ingest_frame(t, idx, msg, msg["data"])
+                else:
+                    # chunked transport: the control message names a
+                    # transfer on the data channel; attach it to the
+                    # reassembly entry (creating one if the chunks
+                    # have not arrived yet)
+                    key = (idx, msg["transfer_id"])
+                    ent = self._rx.get(key)
+                    if ent is None:
+                        self._rx[key] = [
+                            ChunkReceiver(
+                                msg["rid"], msg["transfer_id"],
+                                msg["total"], label=f"rtr{idx}",
+                            ),
+                            msg,
+                        ]
+                    else:
+                        ent[1] = msg
             elif t == "expired":
                 if self.journal.expire_assigned(msg["rid"]):
                     self.expired += 1
             elif t == "returned":
                 self.journal.unassign(msg["rid"])
             elif t == "reject":
-                # replica-side admission disagreement (misconfig):
-                # terminal — recomputing would reject again
-                self.journal.fail(
-                    msg["rid"], f"replica reject: {msg.get('reason')}"
-                )
-                self.failed += 1
+                rid = msg["rid"]
+                reason = str(msg.get("reason") or "")
+                rec = self.journal.records.get(rid)
+                if (
+                    reason.startswith("handoff_error")
+                    and rec is not None
+                    and rec.handoff is not None
+                ):
+                    # typed decode-side import failure (codec/version
+                    # skew, pool mismatch): the journaled bytes are
+                    # unusable — requeue for re-prefill instead of
+                    # failing terminally or crash-looping the resume
+                    if self.journal.reprefill(rid, reason):
+                        self.handoff_reprefills += 1
+                        self._log(
+                            f"rid {rid} handoff rejected by replica "
+                            f"{idx} ({reason}); requeued for re-prefill"
+                        )
+                else:
+                    # replica-side admission disagreement (misconfig):
+                    # terminal — recomputing would reject again
+                    self.journal.fail(rid, f"replica reject: {reason}")
+                    self.failed += 1
         return delivered
+
+    # -- the data plane ----------------------------------------------------
+
+    def _ingest_frame(
+        self, kind: str, idx: int, msg: dict, data_b64: str
+    ) -> None:
+        """A whole handoff/migrate frame arrived (assembled or blob):
+        journal it. Both kinds requeue the rid at the FRONT carrying
+        the bytes — a migrated stream resumes on a sibling exactly the
+        way a prefill handoff resumes on a decode replica."""
+        rid = msg["rid"]
+        if self.journal.handoff(rid, data_b64, msg.get("bytes", 0)):
+            self.handoffs += 1
+            rec = self.journal.records[rid]
+            if rec.engine_ttft is None:
+                rec.engine_ttft = msg.get("ttft")
+            if kind == "migrate":
+                self.drain_migrations += 1
+                self.journal._event("migrate", rid, replica=idx)
+
+    def _pump_channel_msgs(self, idx: int, channel: DataChannel) -> None:
+        """Drain one replica's data channel: acks retire outbound
+        chunks (journaling the progress), data frames feed inbound
+        reassembly."""
+        for m in channel.pump():
+            tid = m["transfer_id"]
+            if m["kind"] == KIND_ACK:
+                ent = self._tx.get(tid)
+                if ent is not None and ent[0] == idx:
+                    if ent[1].on_ack(m):
+                        self.journal.chunk_ack(ent[2], tid, m["seq"])
+            else:
+                key = (idx, tid)
+                ent = self._rx.get(key)
+                if ent is None:
+                    ent = [
+                        ChunkReceiver(
+                            m["rid"], tid, m["total"], label=f"rtr{idx}"
+                        ),
+                        None,
+                    ]
+                    self._rx[key] = ent
+                ent[0].on_chunk(m, channel)
+
+    def _pump_senders(self) -> None:
+        """Drive outbound resume transfers: retransmit timers, the
+        in-flight cap, completion, permanent failure."""
+        for tid in list(self._tx):
+            idx, sender, rid = self._tx[tid]
+            try:
+                sender.pump()
+            except TransportError as e:
+                # retries exhausted / channel gone: the receiving
+                # replica is the suspect — kill it with the
+                # classification pinned; the death sweep requeues the
+                # rid WITH its journaled bytes and the resume replays
+                # whole on the relaunch
+                del self._tx[tid]
+                self.chunks_resent += sender.chunks_resent
+                if sender.chunks_resent:
+                    self.handoff_retries += 1
+                self._log(
+                    f"transfer {tid} (rid {rid}) to replica {idx} "
+                    f"failed: {e}"
+                )
+                self.supervisor.kill(
+                    idx,
+                    classify_as="replica_loss",
+                    note=f"transport: transfer {tid} failed ({e})",
+                )
+                continue
+            if sender.done:
+                del self._tx[tid]
+                self.chunks_resent += sender.chunks_resent
+                if sender.chunks_resent:
+                    self.handoff_retries += 1
+                if sender.resumed:
+                    self.transfers_resumed += 1
+                self.journal.transfer_complete(rid, tid)
+
+    def _finish_rx(self, idx: int) -> None:
+        """Hand completed inbound reassemblies (receiver full AND the
+        control message arrived) to the journal."""
+        for key in [k for k in self._rx if k[0] == idx]:
+            receiver, meta = self._rx[key]
+            if meta is None or not receiver.complete:
+                continue
+            del self._rx[key]
+            data_b64 = base64.b64encode(receiver.assemble()).decode(
+                "ascii"
+            )
+            self._ingest_frame(meta["type"], idx, meta, data_b64)
 
     def _eligible(self, rec: JournalRecord, live: List[int]) -> List[int]:
         """The replica indices allowed to take this record. Unified
@@ -867,10 +1384,11 @@ class FleetRouter:
 
     def _dispatch(self) -> None:
         # only READY replicas take work: a cold replica (importing,
-        # compiling) would sit on assignments the others could serve
+        # compiling) would sit on assignments the others could serve —
+        # and a preempted replica is packing up, not admitting
         live = [
             i for i in self.supervisor.live_indices()
-            if self._ready.get(i)
+            if self._ready.get(i) and i not in self._draining
         ]
         if not live:
             return
@@ -904,10 +1422,57 @@ class FleetRouter:
                 msg = {
                     "type": "resume",
                     "rid": rid,
-                    "data": rec.handoff,
                     "max_new_tokens": rec.max_new_tokens,
                     "deadline_s": remaining,
                 }
+                channel = getattr(handle, "data_channel", None)
+                if (
+                    self.cfg.handoff_transport == "chunked"
+                    and channel is not None
+                ):
+                    data = base64.b64decode(rec.handoff)
+                    # resume an interrupted transfer to the SAME
+                    # incarnation: seed the sender with the journaled
+                    # acked set so only unacked chunks touch the wire
+                    # (a dead incarnation's transfers were aborted in
+                    # the death sweep, so a stale seed cannot match)
+                    tid = None
+                    seed: Set[int] = set()
+                    for t, info in self.journal.transfers.items():
+                        if (
+                            info["rid"] == rid
+                            and info.get("run_id") == run_id
+                            and t not in self._tx
+                        ):
+                            tid = t
+                            seed = set(info["acked"])
+                            break
+                    if tid is None:
+                        tid = next_transfer_id()
+                        self.journal.transfer_begin(
+                            rid, tid, len(split_payload(
+                                data, self.cfg.transport_chunk_bytes
+                            )), len(data), kind="resume", run_id=run_id,
+                        )
+                    sender = ChunkSender(
+                        channel, rid, tid, data,
+                        chunk_bytes=self.cfg.transport_chunk_bytes,
+                        max_inflight_bytes=(
+                            self.cfg.transport_inflight_bytes
+                        ),
+                        retries=self.cfg.transport_retries,
+                        backoff_s=self.cfg.transport_backoff_s,
+                        label=f"rtr{idx}.tx",
+                        acked=seed,
+                    )
+                    self._tx[tid] = (idx, sender, rid)
+                    msg.update(
+                        transfer_id=tid,
+                        total=sender.total,
+                        bytes=len(data),
+                    )
+                else:
+                    msg["data"] = rec.handoff
             else:
                 msg = {
                     "type": "submit",
@@ -941,7 +1506,8 @@ class FleetRouter:
     # -- stats -------------------------------------------------------------
 
     def stats(self) -> Dict[str, float]:
-        """The obs ``serving_fleet`` map (schema v11)."""
+        """The obs ``serving_fleet`` map (schema v11; transport/drain
+        counters added in v15)."""
         c = self.journal.counts()
         lats = sorted(
             r.latency for r in self.completed if r.latency is not None
@@ -975,4 +1541,15 @@ class FleetRouter:
                     r.handoff_bytes for r in self.journal.records.values()
                 )
             ),
+            # streaming transport + drain-and-migrate (v15; live
+            # senders' resends are folded in so mid-run reads are
+            # accurate, not just post-completion totals)
+            "handoff_retries": float(self.handoff_retries),
+            "chunks_resent": float(
+                self.chunks_resent
+                + sum(s.chunks_resent for _, s, _ in self._tx.values())
+            ),
+            "transfers_resumed": float(self.transfers_resumed),
+            "drain_migrations": float(self.drain_migrations),
+            "handoff_reprefills": float(self.handoff_reprefills),
         }

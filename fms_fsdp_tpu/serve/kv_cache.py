@@ -272,23 +272,48 @@ class PagedKVCache:
         written — page 0 stays all-zero (the bit-parity root) and page 1
         stays scratch. ``n_tokens`` is the source pool's token
         accounting for the sequence (its ``tokens_of``)."""
+        from fms_fsdp_tpu.serve.disagg.handoff import HandoffError
+
+        # Wire-derived input: every structural mismatch is a typed
+        # HandoffError, and every check that can run BEFORE allocation
+        # does — a frame rejected after ``ensure`` would leak the
+        # freshly allocated pages if the raise skipped the free.
+        if set(arrays) != set(self.pools):
+            raise HandoffError(
+                f"handoff leaves {sorted(arrays)} do not match this "
+                f"pool's {sorted(self.pools)} — kv_quant mismatch "
+                f"between replicas"
+            )
         n = int(arrays["k"].shape[1])
-        assert set(arrays) == set(self.pools), (
-            f"handoff leaves {sorted(arrays)} do not match this pool's "
-            f"{sorted(self.pools)} — kv_quant mismatch between replicas"
-        )
+        for name, pool in self.pools.items():
+            want = (pool.shape[0], n) + tuple(pool.shape[2:])
+            got = tuple(arrays[name].shape)
+            if got != want:
+                raise HandoffError(
+                    f"handoff leaf {name!r} has shape {got}, this "
+                    f"pool expects {want} — page geometry mismatch"
+                )
         if not self.ensure(seq_id, n * self.page_size):
             return False
         self._seq_tokens[seq_id] = n_tokens
         pages = self._seq_pages[seq_id]
         assert len(pages) == n, (len(pages), n)
         ids = jnp.asarray(pages, jnp.int32)
-        self.pools = {
-            name: pool.at[:, ids].set(
-                jnp.asarray(arrays[name], pool.dtype)
-            )
-            for name, pool in self.pools.items()
-        }
+        try:
+            self.pools = {
+                name: pool.at[:, ids].set(
+                    jnp.asarray(arrays[name], pool.dtype)
+                )
+                for name, pool in self.pools.items()
+            }
+        except Exception as e:
+            # free what this import just allocated before surfacing —
+            # the pool must account identically to before the attempt
+            self.free(seq_id)
+            raise HandoffError(
+                f"handoff scatter failed after page allocation "
+                f"(pages freed): {e}"
+            ) from e
         return True
 
     # -- defrag ------------------------------------------------------------

@@ -55,6 +55,16 @@ PR 19 rows (every row now carries ``spec_accept_rate`` /
   shorts only — the pair quantifies what decode-interleaved chunked
   prefill buys p99 TTFT on a single replica.
 
+PR 20 rows (the chunked state-transfer wire, serve/disagg/transport.py):
+
+- ``fleet-disagg-clean`` / ``fleet-disagg-chunkloss``: the disagg
+  mixed wave with resume handoffs forced through 1 KiB chunks, clean
+  vs ~1% router-side chunk corruption (every corrupted chunk fails its
+  receiver CRC and is retransmitted after backoff). The pair measures
+  what wire-level healing costs tokens/s and short-request p99 TTFT;
+  the chunkloss row carries the measured retry ledger
+  (``handoff_retries`` / ``chunks_resent`` / ``bytes_resent``).
+
 Fallback-tier contract (bench.py's): the engine measures on whatever
 backend answers — on a TPU-less host the numbers are CPU-relative but
 MEASURED, so the record carries ``degraded: false`` with
@@ -425,12 +435,18 @@ def run_longprompt_rows(params, cfg):
 
 
 def _run_fleet(model_cfg_dict, wave, faults="", n_replicas=2, prefill=0,
-               prefix="bench_fleet_"):
+               prefix="bench_fleet_", router_faults="", fleet_kw=None):
     """Drive one fleet over ``wave`` ([(prompt, max_new), ...]).
-    Returns (records_in_submit_order, stats, wall_s)."""
+    Returns (records_in_submit_order, stats, wall_s).
+
+    ``router_faults`` configures fault sites in THIS process (the
+    router-side chunk senders live here; ``faults`` only reaches the
+    replica subprocesses by env). ``fleet_kw`` is folded into
+    FleetConfig — the transport chunk/inflight knobs."""
     import tempfile
     import time as _time
 
+    from fms_fsdp_tpu.resilience.faults import configure_faults
     from fms_fsdp_tpu.serve.fleet import (
         FleetConfig,
         FleetRouter,
@@ -462,16 +478,21 @@ def _run_fleet(model_cfg_dict, wave, faults="", n_replicas=2, prefill=0,
         startup_timeout_s=300.0,
         restart_backoff_s=0.2,
         ledger_path=os.path.join(wdir, "ledger.json"),
+        **(fleet_kw or {}),
     )
     router = FleetRouter(spawn, cfg)
-    router.start()
-    t0 = _time.monotonic()
-    rids = [router.submit(p, n) for p, n in wave]
-    router.run_until_idle(timeout_s=600.0)
-    wall = _time.monotonic() - t0
-    stats = router.stats()
-    router.drain()
-    router.shutdown()
+    configure_faults(router_faults)
+    try:
+        router.start()
+        t0 = _time.monotonic()
+        rids = [router.submit(p, n) for p, n in wave]
+        router.run_until_idle(timeout_s=600.0)
+        wall = _time.monotonic() - t0
+        stats = router.stats()
+        router.drain()
+        router.shutdown()
+    finally:
+        configure_faults("")
     return [router.journal.records[r] for r in rids], stats, wall
 
 
@@ -584,6 +605,73 @@ def run_disagg_rows(model_cfg_dict):
         row["prefill_replicas"] = int(stats["prefill_replicas"])
         row["requests_handed_off"] = int(stats["requests_handed_off"])
         row["handoff_bytes"] = int(stats["handoff_bytes"])
+        rows.append(row)
+    return rows
+
+
+def run_transport_rows(model_cfg_dict):
+    """``fleet-disagg-clean`` vs ``fleet-disagg-chunkloss``: the disagg
+    mixed wave with the resume direction forced through small (1 KiB)
+    chunks, clean vs ~1% chunk corruption on the router-side senders
+    (``handoff_chunk_corrupt:transport=rtr:every=77`` — a disagg
+    handoff averages ~77 KiB, so roughly one corrupted chunk per
+    transfer). A corrupted chunk fails its CRC at the receiver, is
+    never acked, and is retransmitted after backoff: the pair measures
+    what wire-level healing costs tokens/s and p99 TTFT, and the
+    chunkloss row carries the measured retry ledger
+    (``handoff_retries`` / ``chunks_resent`` / ``bytes_resent``)."""
+    import numpy as np
+
+    chunk_bytes = 1024
+    tkw = {
+        "transport_chunk_bytes": chunk_bytes,
+        "transport_inflight_bytes": 8 * 1024,
+        # a generous ack deadline: on a CPU host the decode replica's
+        # first transfer lands during jit warmup, and the default 50 ms
+        # backoff would count warmup stalls as resends — with 2 s only
+        # genuinely lost (corrupted) chunks retransmit, so the
+        # chunkloss row's ledger measures the injected fault
+        "transport_backoff_s": 2.0,
+    }
+    rng = np.random.default_rng(0)
+    vocab = model_cfg_dict["src_vocab_size"]
+    long_len = min(4 * PROMPT, SEQ - NEW - 1)
+    wave, short_idx = [], []
+    for _ in range(max(2, REQUESTS // 4)):
+        wave.append(
+            (rng.integers(0, vocab, size=long_len).tolist(), NEW)
+        )
+    for _ in range(REQUESTS):
+        short_idx.append(len(wave))
+        wave.append((rng.integers(0, vocab, size=8).tolist(), NEW))
+
+    rows = []
+    for mode, spec in (
+        ("fleet-disagg-clean", ""),
+        ("fleet-disagg-chunkloss",
+         "handoff_chunk_corrupt:transport=rtr:every=77"),
+    ):
+        recs, stats, wall = _run_fleet(
+            model_cfg_dict, wave, n_replicas=3, prefill=1,
+            prefix=f"bench_{mode.replace('-', '_')}_",
+            router_faults=spec, fleet_kw=tkw,
+        )
+        row = _fleet_row(
+            mode, recs, stats, wall,
+            ttft_recs=[recs[i] for i in short_idx],
+        )
+        row["prompt_len"] = 8
+        row["interferer_prompt_len"] = long_len
+        row["interferers"] = len(wave) - len(short_idx)
+        row["prefill_replicas"] = int(stats["prefill_replicas"])
+        row["requests_handed_off"] = int(stats["requests_handed_off"])
+        row["handoff_bytes"] = int(stats["handoff_bytes"])
+        row["transport_chunk_bytes"] = chunk_bytes
+        row["handoff_retries"] = int(stats["handoff_retries"])
+        row["chunks_resent"] = int(stats["chunks_resent"])
+        # retransmits carry full chunks; the last chunk of a transfer
+        # is the only shorter one, so this over-counts by < 1 chunk
+        row["bytes_resent"] = int(stats["chunks_resent"]) * chunk_bytes
         rows.append(row)
     return rows
 
@@ -724,6 +812,10 @@ def main():
             # unified vs disaggregated fleets on the mixed wave: the
             # short-request p99-TTFT pair
             *run_disagg_rows(dataclasses.asdict(cfg)),
+            # the disagg wave again over the chunked resume wire,
+            # clean vs ~1% chunk corruption: what transport healing
+            # costs (docs/serving.md "Streaming transport & drain")
+            *run_transport_rows(dataclasses.asdict(cfg)),
         ]
     backend = jax.default_backend()
     result = {

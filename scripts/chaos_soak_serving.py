@@ -53,6 +53,26 @@ KV-page bytes replay as ``resume`` on the sibling). Both must complete
 every request with tokens identical to the unified fleet's — the
 end-to-end proof that handoff pages ship bit-exact.
 
+``--transport`` soaks the chunked state-transfer wire itself
+(serve/disagg/transport.py) end to end:
+
+- a disagg fleet with BOTH wire directions corrupted and lossy
+  (``handoff_chunk_corrupt`` + ``handoff_chunk_drop`` against every
+  ``.tx`` sender label, router process included) must complete the
+  wave token-identical — CRC drops heal by retransmit, and the
+  router's counters prove it (``handoff_retries``, ``chunks_resent``,
+  ``transfers_resumed`` all > 0);
+- mid-transfer kills on either wire side (the prefill worker shipping
+  chunks, the decode worker receiving a resume) requeue exactly-once —
+  the journaled transfer to the dead incarnation is aborted and the
+  bytes replay in full to the sibling;
+- a SIGTERM ``preempt`` of a hybrid-mamba replica drains-and-migrates:
+  every live stream packs (conv window + fp32 SSD slab + hybrid pages)
+  and arrives at a sibling as a ``migrate`` transfer —
+  ``drain_migrations`` > 0, the journal shows the migrated rids
+  resumed WITHOUT a recompute requeue, the exit classifies
+  ``preempted``, and tokens still match the unfaulted mamba fleet.
+
 Writes ``fleet_soak.json`` (summary) plus per-incarnation replica
 stderr logs and the request journal / restart ledger under ``--out``.
 
@@ -148,7 +168,8 @@ def make_wave(n, seed):
 
 
 def run_fleet(tag, workdir, faults="", n_replicas=2, prefill=0,
-              serve_cfg=None, stall_timeout=None):
+              serve_cfg=None, stall_timeout=None, router_faults="",
+              fleet_kw=None, on_poll=None):
     """One fleet run over the wave. Returns (tokens_by_rid, stats,
     ledger, wall_s). ``prefill`` > 0 turns the fleet disaggregated:
     replicas [0, prefill) run role=prefill, the rest role=decode, and
@@ -156,7 +177,14 @@ def run_fleet(tag, workdir, faults="", n_replicas=2, prefill=0,
     ``serve_cfg`` overrides the shared SERVE_CFG (the --speculative
     schedule's speculator_path); ``stall_timeout`` overrides the
     per-family watchdog (the speculative verify step adds a jit
-    compile the 10s llama default would misread as a stall)."""
+    compile the 10s llama default would misread as a stall).
+    ``router_faults`` configures fault sites in THIS process too (the
+    router hosts the resume-direction chunk senders); ``fleet_kw``
+    passes extra FleetConfig knobs (transport chunk sizes);
+    ``on_poll(router)`` runs every poll tick — the --transport drain
+    schedule uses it to preempt a replica mid-wave."""
+    from fms_fsdp_tpu.resilience.faults import configure_faults
+
     scfg = serve_cfg or SERVE_CFG
     wdir = os.path.join(workdir, tag)
     spawn = make_subprocess_spawn(
@@ -180,12 +208,28 @@ def run_fleet(tag, workdir, faults="", n_replicas=2, prefill=0,
         restart_backoff_s=0.2,
         journal_path=os.path.join(wdir, "journal.jsonl"),
         ledger_path=os.path.join(wdir, "ledger.json"),
+        **(fleet_kw or {}),
     )
     router = FleetRouter(spawn, cfg)
+    configure_faults(router_faults)
     router.start()
     t0 = time.monotonic()
     rids = [router.submit(p, MAX_NEW) for p in make_wave(N_REQUESTS, SEED)]
-    router.run_until_idle(timeout_s=300.0)
+    try:
+        if on_poll is None:
+            router.run_until_idle(timeout_s=300.0)
+        else:
+            deadline = time.monotonic() + 300.0
+            while router.journal.outstanding() > 0:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"[{tag}] fleet not idle: {router.journal.counts()}"
+                    )
+                router.poll()
+                on_poll(router)
+                time.sleep(0.01)
+    finally:
+        configure_faults("")
     wall = time.monotonic() - t0
     stats = router.stats()
     router.drain()
@@ -335,6 +379,166 @@ def run_disagg_soak(out):
           f"{dk_stats['availability']:.4f}")
 
 
+def _assert_migrated_not_recomputed(out, tag):
+    """The drain proof lives in the journal: every rid with a
+    ``migrate`` event must resume through its re-journaled bytes —
+    assign + complete afterwards, with NO recompute-path event
+    (returned/requeue/reprefill) in between."""
+    events_by_rid = {}
+    with open(os.path.join(out, tag, "journal.jsonl")) as f:
+        for line in f:
+            ev = json.loads(line)
+            events_by_rid.setdefault(ev.get("rid"), []).append(ev)
+    migrated = [
+        rid for rid, evs in events_by_rid.items()
+        if any(e["event"] == "migrate" for e in evs)
+    ]
+    assert migrated, f"[{tag}] no stream migrated — drain landed idle"
+    recompute_kinds = {"returned", "requeue", "reprefill"}
+    for rid in migrated:
+        evs = events_by_rid[rid]
+        after = evs[
+            max(i for i, e in enumerate(evs) if e["event"] == "migrate")
+            + 1:
+        ]
+        kinds = [e["event"] for e in after]
+        assert "complete" in kinds, (tag, rid, kinds)
+        bad = recompute_kinds.intersection(kinds)
+        assert not bad, (
+            f"[{tag}] rid {rid} fell back to recompute ({sorted(bad)}) "
+            f"after its migrate frame — zero-recompute drain violated"
+        )
+    return migrated
+
+
+def run_transport_soak(out):
+    """--transport: the chunked wire under corruption/loss, mid-transfer
+    kills on both wire sides, and a SIGTERM drain-and-migrate of a
+    hybrid-mamba replica. Five runs (see module docstring)."""
+    global MODEL_CFG, FAMILY
+    # small chunks + a tight in-flight cap force every handoff across
+    # multiple pump cycles, so faults land MID-transfer, not between
+    # transfers; chunk counters are per-sender, so each transfer must
+    # span more chunks than the largest every= below for a fault to be
+    # guaranteed to land on it (tiny-model handoffs are ~8 KiB)
+    tkw = {
+        "transport_chunk_bytes": 1024,
+        "transport_inflight_bytes": 4 * 1024,
+    }
+    ref_tokens, ref_stats, _, _ = run_fleet("reference", out)
+    assert ref_stats["restarts"] == 0, "reference run must be unfaulted"
+
+    # 1. both wire directions corrupted AND lossy: the ".tx" label
+    # substring matches the replica-side (repN.tx) and router-side
+    # (rtrN.tx) chunk senders; the router process needs the spec
+    # configured in-process (router_faults), the replicas get it by env
+    wire_spec = ("handoff_chunk_corrupt:transport=.tx:every=5;"
+                 "handoff_chunk_drop:transport=.tx:every=7")
+    cr_tokens, cr_stats, _, _ = run_fleet(
+        "chunk_chaos", out, faults=wire_spec, router_faults=wire_spec,
+        n_replicas=3, prefill=1, fleet_kw=tkw,
+    )
+    for rid, toks in ref_tokens.items():
+        assert cr_tokens[rid] == toks, (
+            f"[chunk_chaos] rid {rid} tokens diverged under chunk "
+            f"corruption/loss:\n  ref: {toks}\n  got: {cr_tokens[rid]}"
+        )
+    assert cr_stats["requests_handed_off"] >= N_REQUESTS, cr_stats
+    # the healing is measured, not incidental: resume-direction
+    # transfers retried, resent chunks, and completed as resumed
+    assert cr_stats["chunks_resent"] > 0, cr_stats
+    assert cr_stats["handoff_retries"] > 0, cr_stats
+    assert cr_stats["transfers_resumed"] > 0, cr_stats
+    print(f"[chunk_chaos] retries={cr_stats['handoff_retries']:.0f} "
+          f"chunks_resent={cr_stats['chunks_resent']:.0f} "
+          f"transfers_resumed={cr_stats['transfers_resumed']:.0f}")
+
+    # 2./3. mid-transfer kill on both wire sides: the prefill worker
+    # dies while SHIPPING chunked handoffs, a decode worker dies while
+    # RECEIVING chunked resumes — both requeue exactly-once
+    pk_tokens, pk_stats, pk_ledger, _ = run_fleet(
+        "prefill_kill", out,
+        faults="replica_kill:replica=0:step=5:times=1",
+        n_replicas=3, prefill=1, fleet_kw=tkw,
+    )
+    assert_disagg("prefill_kill", out, ref_tokens, pk_tokens, pk_stats,
+                  pk_ledger)
+    dk_tokens, dk_stats, dk_ledger, _ = run_fleet(
+        "decode_kill", out,
+        faults="replica_kill:replica=1:step=10:times=1",
+        n_replicas=3, prefill=1, fleet_kw=tkw,
+    )
+    assert_disagg("decode_kill", out, ref_tokens, dk_tokens, dk_stats,
+                  dk_ledger)
+
+    # 4./5. drain-and-migrate on a hybrid-mamba fleet: the preempted
+    # replica packs each live stream through the SLAB codec (conv
+    # window + fp32 SSD state + hybrid pages) and ships it to the
+    # sibling — planned eviction, zero recompute
+    llama_cfg, llama_family = MODEL_CFG, FAMILY
+    MODEL_CFG, FAMILY = MODEL_CFGS["mamba"], "mamba"
+    try:
+        mref_tokens, mref_stats, _, _ = run_fleet("mamba_reference", out)
+        assert mref_stats["restarts"] == 0, mref_stats
+
+        preempted = []
+
+        def preempt_once(router):
+            if preempted:
+                return
+            counts = router.journal.counts()
+            live = router.supervisor.live_indices()
+            if 1 not in live or counts["completed"] < 1:
+                return  # let the fleet warm up past the first compile
+            if router.journal.inflight(router.supervisor.run_id(1)) >= 2:
+                router.preempt(1)
+                preempted.append(True)
+
+        dr_tokens, dr_stats, dr_ledger, _ = run_fleet(
+            "mamba_drain", out, fleet_kw=tkw, on_poll=preempt_once,
+        )
+        assert preempted, "[mamba_drain] the wave finished before the " \
+                          "preempt trigger armed — raise FLEET_SOAK_REQUESTS"
+        for rid, toks in mref_tokens.items():
+            assert dr_tokens[rid] == toks, (
+                f"[mamba_drain] rid {rid} tokens diverged after "
+                f"drain-and-migrate:\n  ref: {toks}\n  got: {dr_tokens[rid]}"
+            )
+        assert dr_stats["drain_migrations"] >= 1, dr_stats
+        classes = [e["classification"] for e in dr_ledger["entries"]]
+        assert "preempted" in classes, (classes, "SIGTERM did not "
+                                        "classify as a planned eviction")
+        migrated = _assert_migrated_not_recomputed(out, "mamba_drain")
+        print(f"[mamba_drain] migrated={len(migrated)} rids "
+              f"{sorted(migrated)} drain_migrations="
+              f"{dr_stats['drain_migrations']:.0f}")
+    finally:
+        MODEL_CFG, FAMILY = llama_cfg, llama_family
+
+    validate_obs_map(cr_stats)
+    validate_obs_map(dr_stats)
+
+    summary = {
+        "mode": "transport",
+        "requests": N_REQUESTS,
+        "reference": ref_stats,
+        "chunk_chaos": cr_stats,
+        "prefill_kill": pk_stats,
+        "decode_kill": dk_stats,
+        "mamba_reference": mref_stats,
+        "mamba_drain": dr_stats,
+        "zero_drops": True,
+        "token_parity": True,
+    }
+    with open(os.path.join(out, "fleet_soak_transport.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print("transport chaos soak PASSED: zero drops + token parity under "
+          "chunk corruption/loss "
+          f"(retries={cr_stats['handoff_retries']:.0f}), mid-transfer "
+          "kills on both wire sides, and mamba drain-and-migrate "
+          f"(migrations={dr_stats['drain_migrations']:.0f})")
+
+
 def _write_speculator(out):
     """Random-init serving speculator checkpoint for the --speculative
     schedule. The soak pins PARITY (speculative greedy == plain greedy
@@ -449,12 +653,19 @@ def main():
                          "MLPSpeculator draft/verify on every replica) "
                          "and assert greedy token parity against the "
                          "plain fleet's reference run")
+    ap.add_argument("--transport", action="store_true",
+                    help="soak the chunked state-transfer wire: chunk "
+                         "corruption/loss on both directions, "
+                         "mid-transfer kills on both wire sides, and a "
+                         "SIGTERM drain-and-migrate of a hybrid-mamba "
+                         "replica (slab codec, zero recompute)")
     args = ap.parse_args()
     MODEL_CFG = MODEL_CFGS[args.family]
     FAMILY = args.family
     if args.disagg and args.family != "llama":
-        ap.error("--disagg requires --family llama (mamba's slab state "
-                 "has no page handoff; its adapter is unified-only)")
+        ap.error("--disagg requires --family llama (the mamba slab "
+                 "codec is exercised by the --transport schedule's "
+                 "drain-and-migrate leg instead)")
     if args.speculative and args.family != "llama":
         ap.error("--speculative requires --family llama (the "
                  "MLPSpeculator draft/verify loop is llama-only)")
@@ -462,8 +673,17 @@ def main():
         ap.error("--speculative and --disagg are mutually exclusive: a "
                  "speculative engine rejects handoff resumes (the draft "
                  "embedding is not part of the page handoff)")
+    if args.transport and (args.disagg or args.speculative
+                           or args.family != "llama"):
+        ap.error("--transport is its own schedule (it runs disagg-llama "
+                 "wire legs AND a mamba drain leg internally); pass it "
+                 "alone")
     out = args.out or tempfile.mkdtemp(prefix=f"fleet_soak_{FAMILY}_")
     os.makedirs(out, exist_ok=True)
+    if args.transport:
+        print(f"transport chaos soak -> {out}")
+        run_transport_soak(out)
+        return
     if args.disagg:
         print(f"disagg serving chaos soak ({FAMILY} fleet) -> {out}")
         run_disagg_soak(out)

@@ -330,7 +330,7 @@ def test_prefill_handoff_max_bytes_rejects_typed(tiny_params):
     assert "handoff_max_bytes" in str(ei.value)
 
 
-def test_mamba_rejects_roles_and_layouts():
+def test_mamba_roles_and_layout_gates():
     from fms_fsdp_tpu.models.configs import MambaConfig
     from fms_fsdp_tpu.models.mamba import init_mamba_params
 
@@ -339,10 +339,10 @@ def test_mamba_rejects_roles_and_layouts():
         chunk_size=8, attn_layer_idx=(), d_intermediate=128,
     )
     params = init_mamba_params(jax.random.PRNGKey(0), cfg)
-    with pytest.raises(ValueError, match="unified"):
-        ServingEngine(
-            params, cfg, _scfg(role="prefill", kv_quant="none")
-        )
+    # mamba ships its recurrent state via the slab codec now: disagg
+    # roles construct (full parity is pinned in tests/test_transport.py)
+    pe = ServingEngine(params, cfg, _scfg(role="prefill", kv_quant="none"))
+    assert pe.adapter.supports_handoff
     with pytest.raises(ValueError, match="single-chip"):
         ServingEngine(
             params, cfg, _scfg(serve_layout="tp=2", kv_quant="none")
